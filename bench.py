@@ -1,0 +1,39 @@
+"""Benchmark entrypoint for the driver.
+
+The reference repository `mark1222/arena` is empty (zero files — see
+SURVEY.md and NON_GRAFTABLE.md for the verification evidence), so there is
+no workload to benchmark and no baseline to compare against
+(BASELINE.json: "N/A — no runnable entrypoint to benchmark").
+
+This script exists so the driver's mandatory bench step records the true
+state in machine-readable form instead of crashing on a missing file. It
+deliberately reports no performance number: any number here would be
+fabricated. The reported value is the *observed* count of entries (files,
+directories, symlinks) under the reference mount, so a future re-mount of
+a non-empty reference shows up here instead of being masked by a
+hardcoded zero. A missing or unreadable mount is reported as a distinct
+metric rather than as value 0.
+"""
+
+import json
+import os
+import pathlib
+
+REFERENCE = pathlib.Path("/root/reference")
+
+if REFERENCE.is_dir() and os.access(REFERENCE, os.R_OK | os.X_OK):
+    result = {
+        "metric": "non_graftable_reference_is_empty",
+        "value": sum(1 for _ in REFERENCE.rglob("*")),
+        "unit": "reference_entries",
+        "vs_baseline": None,
+    }
+else:
+    result = {
+        "metric": "reference_mount_missing_or_unreadable",
+        "value": -1,
+        "unit": "reference_entries",
+        "vs_baseline": None,
+    }
+
+print(json.dumps(result))
