@@ -11,29 +11,87 @@ deliberately reports no performance number: any number here would be
 fabricated. The reported value is the *observed* count of entries (files,
 directories, symlinks) under the reference mount, so a future re-mount of
 a non-empty reference shows up here instead of being masked by a
-hardcoded zero. A missing or unreadable mount is reported as a distinct
-metric rather than as value 0.
+hardcoded zero.
+
+Distinct metrics for distinct failure modes (each still exactly one JSON
+line on stdout, exit code 0 — the driver contract):
+
+- ``non_graftable_reference_is_empty`` — mount present and readable;
+  value is the observed entry count (0 today; >0 would mean the
+  reference changed and SURVEY.md is obsolete).
+- ``reference_mount_missing_or_unreadable`` — mount absent, not a
+  directory, or not traversable; value -1.
+- ``reference_scan_error`` — the mount passed the initial checks but the
+  recursive walk raised OSError partway through (stale mount, entry
+  vanishing mid-iteration, unreadable subtree); value -1.
+
+The reference path can be overridden with the GRAFT_REFERENCE_PATH
+environment variable so tests can exercise every branch against temp
+directories without touching the real mount.
 """
 
 import json
 import os
 import pathlib
+import sys
 
-REFERENCE = pathlib.Path("/root/reference")
+DEFAULT_REFERENCE = "/root/reference"
 
-if REFERENCE.is_dir() and os.access(REFERENCE, os.R_OK | os.X_OK):
-    result = {
+
+def _count_entries(reference: pathlib.Path) -> int:
+    """Recursive entry count with I/O errors OBSERVABLE, not swallowed.
+
+    pathlib's glob machinery suppresses scan errors (PermissionError on
+    3.12, all OSErrors on 3.13+), which would silently undercount a
+    mount that goes stale or has an unreadable subtree — reporting a
+    half-scanned tree as authoritative. os.walk with onerror re-raising
+    makes every scandir failure propagate to the caller instead.
+    """
+
+    def _raise(err):
+        raise err
+
+    count = 0
+    for _dirpath, dirnames, filenames in os.walk(reference, onerror=_raise):
+        count += len(dirnames) + len(filenames)
+    return count
+
+
+def scan(reference: pathlib.Path) -> dict:
+    """Return the bench result dict for the given reference mount."""
+    try:
+        accessible = reference.is_dir() and os.access(reference, os.R_OK | os.X_OK)
+    except OSError:
+        accessible = False
+    if not accessible:
+        return {
+            "metric": "reference_mount_missing_or_unreadable",
+            "value": -1,
+            "unit": "reference_entries",
+            "vs_baseline": None,
+        }
+    try:
+        count = _count_entries(reference)
+    except OSError:
+        return {
+            "metric": "reference_scan_error",
+            "value": -1,
+            "unit": "reference_entries",
+            "vs_baseline": None,
+        }
+    return {
         "metric": "non_graftable_reference_is_empty",
-        "value": sum(1 for _ in REFERENCE.rglob("*")),
-        "unit": "reference_entries",
-        "vs_baseline": None,
-    }
-else:
-    result = {
-        "metric": "reference_mount_missing_or_unreadable",
-        "value": -1,
+        "value": count,
         "unit": "reference_entries",
         "vs_baseline": None,
     }
 
-print(json.dumps(result))
+
+def main() -> int:
+    reference = pathlib.Path(os.environ.get("GRAFT_REFERENCE_PATH", DEFAULT_REFERENCE))
+    print(json.dumps(scan(reference)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

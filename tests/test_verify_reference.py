@@ -1,0 +1,208 @@
+"""Tests for verify_reference.py — the mechanical round-start gate.
+
+Contract: exactly one JSON line on stdout; exit 0 when the live state
+matches the committed fingerprint, 1 on any drift (reference tree
+non-empty, sidecar hashes changed, SNIPPETS.md appearing), 2 when the
+fingerprint itself is missing or corrupt.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+import verify_reference  # noqa: E402
+
+BASELINE_CONTENT = '{"north_star": "non-graftable"}\n'
+PAPERS_CONTENT = "# PAPERS\n"
+
+
+def make_repo(tmp_path, with_snippets=False):
+    """A fake repo dir whose fingerprint matches its own sidecars."""
+    import hashlib
+
+    repo = tmp_path / "repo"
+    repo.mkdir()
+    (repo / "BASELINE.json").write_text(BASELINE_CONTENT)
+    (repo / "PAPERS.md").write_text(PAPERS_CONTENT)
+    if with_snippets:
+        (repo / "SNIPPETS.md").write_text("# SNIPPETS\n")
+    fingerprint = {
+        "reference_entry_count": 0,
+        "baseline_json_sha256": hashlib.sha256(BASELINE_CONTENT.encode()).hexdigest(),
+        "papers_md_sha256": hashlib.sha256(PAPERS_CONTENT.encode()).hexdigest(),
+        "snippets_md_present": False,
+    }
+    (repo / "reference_fingerprint.json").write_text(json.dumps(fingerprint))
+    return repo
+
+
+def run_verify(reference_path, repo_path):
+    env = dict(os.environ)
+    env["GRAFT_REFERENCE_PATH"] = str(reference_path)
+    env["GRAFT_REPO_PATH"] = str(repo_path)
+    return subprocess.run(
+        [sys.executable, str(REPO / "verify_reference.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd="/tmp",
+    )
+
+
+def parse_single_json_line(proc):
+    assert proc.stderr == ""
+    lines = proc.stdout.splitlines()
+    assert len(lines) == 1
+    return json.loads(lines[0])
+
+
+def test_empty_reference_matches_fingerprint(tmp_path):
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    proc = run_verify(ref, make_repo(tmp_path))
+    result = parse_single_json_line(proc)
+    assert proc.returncode == 0
+    assert result["reference_empty"] is True
+    assert result["matches_fingerprint"] is True
+    assert result["drift"] == []
+
+
+def test_populated_reference_is_drift(tmp_path):
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+    (ref / "src" / "main.cu").write_text("// code\n")
+    proc = run_verify(ref, make_repo(tmp_path))
+    result = parse_single_json_line(proc)
+    assert proc.returncode == 1
+    assert result["reference_empty"] is False
+    assert result["matches_fingerprint"] is False
+    assert result["transient_environment_failure"] is False
+    assert "DRIFT" in result["note"]
+    drifted = {d["fact"] for d in result["drift"]}
+    assert drifted == {"reference_entry_count"}
+    assert result["observed"]["reference_entry_count"] == 2
+
+
+def test_missing_reference_is_transient_failure_not_drift(tmp_path):
+    proc = run_verify(tmp_path / "gone", make_repo(tmp_path))
+    result = parse_single_json_line(proc)
+    assert proc.returncode == 1
+    assert result["observed"]["reference_entry_count"] == "mount_missing_or_unreadable"
+    # The JSON evidence line must self-describe this as environmental,
+    # not as the reference having changed (SKILL.md semantics).
+    assert result["transient_environment_failure"] is True
+    assert "TRANSIENT" in result["note"]
+
+
+def test_changed_baseline_sidecar_is_drift(tmp_path):
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    repo = make_repo(tmp_path)
+    (repo / "BASELINE.json").write_text('{"north_star": "now it has code!"}\n')
+    proc = run_verify(ref, repo)
+    result = parse_single_json_line(proc)
+    assert proc.returncode == 1
+    drifted = {d["fact"] for d in result["drift"]}
+    assert drifted == {"baseline_json_sha256"}
+    # the reference itself is still empty; only the sidecar moved
+    assert result["reference_empty"] is True
+
+
+def test_snippets_appearing_is_drift(tmp_path):
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    repo = make_repo(tmp_path, with_snippets=True)
+    proc = run_verify(ref, repo)
+    result = parse_single_json_line(proc)
+    assert proc.returncode == 1
+    drifted = {d["fact"] for d in result["drift"]}
+    assert drifted == {"snippets_md_present"}
+
+
+def test_scan_error_maps_to_sentinel(tmp_path, monkeypatch):
+    """A mid-walk OSError (via the shared bench.scan) becomes the
+    'scan_error' sentinel, which mismatches the fingerprint's 0 and is
+    documented as a transient environment failure, not a changed tree."""
+
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    real_scandir = os.scandir
+
+    def flaky_scandir(path=".", *args, **kwargs):
+        if pathlib.Path(path) == bad:
+            raise OSError("mount went stale mid-iteration")
+        return real_scandir(path, *args, **kwargs)
+
+    monkeypatch.setattr(os, "scandir", flaky_scandir)
+    assert verify_reference.count_entries(tmp_path) == "scan_error"
+
+
+def test_count_entries_delegates_to_bench(tmp_path):
+    """bench.scan and the round-start gate must agree on the same mount."""
+    (tmp_path / "a").mkdir()
+    (tmp_path / "a" / "b.txt").write_text("x")
+    assert verify_reference.count_entries(tmp_path) == 2
+    assert verify_reference.count_entries(tmp_path / "gone") == (
+        "mount_missing_or_unreadable"
+    )
+
+
+def test_missing_fingerprint_exits_2(tmp_path):
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    repo = tmp_path / "bare"
+    repo.mkdir()
+    proc = run_verify(ref, repo)
+    result = parse_single_json_line(proc)
+    assert proc.returncode == 2
+    assert result["error"] == "fingerprint_missing_or_corrupt"
+
+
+def test_corrupt_fingerprint_exits_2(tmp_path):
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    repo = make_repo(tmp_path)
+    (repo / "reference_fingerprint.json").write_text("{not json")
+    proc = run_verify(ref, repo)
+    result = parse_single_json_line(proc)
+    assert proc.returncode == 2
+
+
+def test_non_object_json_fingerprint_exits_2(tmp_path):
+    """Valid JSON that is not an object (null, list, scalar) is corrupt,
+    not drift: must take the exit-2 path, not crash with rc 1."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    repo = make_repo(tmp_path)
+    for payload in ("null", "[]", '"x"', "42"):
+        (repo / "reference_fingerprint.json").write_text(payload)
+        proc = run_verify(ref, repo)
+        result = parse_single_json_line(proc)
+        assert proc.returncode == 2, payload
+        assert result["error"] == "fingerprint_missing_or_corrupt"
+
+
+def test_real_repo_fingerprint_matches_live_mount():
+    """The committed fingerprint must match the real repo sidecars; and
+    unless the driver re-mounted a different reference, the live mount
+    must still be empty."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "verify_reference.py")],
+        capture_output=True,
+        text=True,
+        cwd="/tmp",
+    )
+    result = parse_single_json_line(proc)
+    # Sidecar hashes are committed alongside the sidecars, so a mismatch
+    # here is a repo bug (stale fingerprint), not environment drift.
+    sidecar_drift = [
+        d for d in result["drift"] if d["fact"] != "reference_entry_count"
+    ]
+    assert sidecar_drift == [], (
+        "reference_fingerprint.json is stale relative to the committed "
+        f"sidecars: {sidecar_drift}"
+    )
