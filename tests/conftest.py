@@ -1,0 +1,179 @@
+"""Shared fixtures for the contract tests.
+
+Two cost facts shape the design (measured on this image):
+
+- plain ``python -c pass`` takes ~1.7s because sitecustomize imports
+  jax for every interpreter, and the machine has ONE cpu — so every
+  subprocess test costs ~1.7s of wall clock that cannot be parallelized
+  away;
+- in-process calls to bench.main() / verify_reference.main() cost
+  milliseconds.
+
+So the matrix of mount states is tested in-process (monkeypatched env +
+capsys), and only FOUR true-subprocess end-to-end runs exist — two per
+script. Per script, one runs exactly as the driver does (plain
+``python``, paying the site cost) and one runs with ``-S`` (site
+skipped; both scripts import only the stdlib, so site processing is
+irrelevant to the argv/env/stdout/rc plumbing under test). All four are
+launched concurrently, but only on the FIRST request of the ``e2e``
+fixture — a partial run (``-k``, ``--collect-only``) that deselects the
+e2e tests never spawns them and never touches the real mount or repo.
+"""
+
+import hashlib
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+from types import SimpleNamespace
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+BASELINE_CONTENT = '{"north_star": "non-graftable"}\n'
+PAPERS_CONTENT = "# PAPERS\n"
+
+
+def make_fake_repo(
+    root: pathlib.Path,
+    name: str = "repo",
+    with_snippets: bool = False,
+    entry_count: int = 0,
+):
+    """A fake repo dir whose fingerprint matches its own sidecars."""
+    repo = root / name
+    repo.mkdir(parents=True)
+    (repo / "BASELINE.json").write_text(BASELINE_CONTENT)
+    (repo / "PAPERS.md").write_text(PAPERS_CONTENT)
+    if with_snippets:
+        (repo / "SNIPPETS.md").write_text("# SNIPPETS\n")
+    fingerprint = {
+        "reference_entry_count": entry_count,
+        "baseline_json_sha256": hashlib.sha256(BASELINE_CONTENT.encode()).hexdigest(),
+        "papers_md_sha256": hashlib.sha256(PAPERS_CONTENT.encode()).hexdigest(),
+        "snippets_md_present": False,
+    }
+    (repo / "reference_fingerprint.json").write_text(json.dumps(fingerprint))
+    return repo
+
+
+def make_populated_reference(root: pathlib.Path, name: str = "ref"):
+    """A non-empty reference tree: src/, src/main.cu, README.md (3 entries)."""
+    ref = root / name
+    (ref / "src").mkdir(parents=True)
+    (ref / "src" / "main.cu").write_text("// not empty\n")
+    (ref / "README.md").write_text("hello\n")
+    return ref
+
+
+@pytest.fixture
+def fake_repo(tmp_path):
+    return make_fake_repo(tmp_path)
+
+
+def _clean_env(**overrides):
+    env = {k: v for k, v in os.environ.items() if not k.startswith("GRAFT_")}
+    env.update(overrides)
+    return env
+
+
+def _launch_e2e():
+    root = pathlib.Path(tempfile.mkdtemp(prefix="graft-e2e-"))
+    bench_ref = make_populated_reference(root, "bench_ref")
+    bench_repo = make_fake_repo(root, "bench_repo")
+    verify_ref = make_populated_reference(root, "verify_ref")
+    verify_repo = make_fake_repo(root, "verify_repo")
+
+    def spawn(script, env, site=True):
+        argv = [sys.executable] + ([] if site else ["-S"]) + [str(REPO / script)]
+        return subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd="/tmp",  # must work from any cwd
+        )
+
+    procs = {
+        # Exactly the driver's invocation: plain python, real mount, real repo.
+        "bench_real": SimpleNamespace(
+            proc=spawn("bench.py", _clean_env()), repo=REPO
+        ),
+        "bench_populated": SimpleNamespace(
+            proc=spawn(
+                "bench.py",
+                _clean_env(
+                    GRAFT_REFERENCE_PATH=str(bench_ref),
+                    GRAFT_REPO_PATH=str(bench_repo),
+                ),
+                site=False,
+            ),
+            repo=bench_repo,
+        ),
+        # Exactly the documented round-start gate: plain python, real everything.
+        "verify_real": SimpleNamespace(
+            proc=spawn("verify_reference.py", _clean_env()), repo=REPO
+        ),
+        "verify_populated": SimpleNamespace(
+            proc=spawn(
+                "verify_reference.py",
+                _clean_env(
+                    GRAFT_REFERENCE_PATH=str(verify_ref),
+                    GRAFT_REPO_PATH=str(verify_repo),
+                ),
+                site=False,
+            ),
+            repo=verify_repo,
+        ),
+    }
+    return root, procs
+
+
+_E2E_STATE = {"root": None, "procs": None}
+
+
+@pytest.fixture(scope="session")
+def e2e():
+    root, procs = _launch_e2e()
+    _E2E_STATE["root"], _E2E_STATE["procs"] = root, procs
+    results = {}
+    for name, entry in procs.items():
+        out, err = entry.proc.communicate(timeout=120)
+        results[name] = SimpleNamespace(
+            rc=entry.proc.returncode, out=out, err=err, repo=entry.repo
+        )
+    return results
+
+
+@pytest.fixture
+def deny_manifest_write(monkeypatch):
+    """Writing the manifest fails like a read-only repo dir; everything
+    else writes normally. Shared so the name-based match lives in one
+    place if the manifest write strategy ever changes. startswith: the
+    atomic write goes through MANIFEST_NAME + '.tmp'."""
+    import verify_reference
+
+    real_write_text = pathlib.Path.write_text
+
+    def deny(self, *args, **kwargs):
+        if self.name.startswith(verify_reference.MANIFEST_NAME):
+            raise OSError("read-only file system")
+        return real_write_text(self, *args, **kwargs)
+
+    monkeypatch.setattr(pathlib.Path, "write_text", deny)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _E2E_STATE["procs"]:
+        for entry in _E2E_STATE["procs"].values():
+            if entry.proc.poll() is None:
+                entry.proc.kill()
+                entry.proc.wait()
+    if _E2E_STATE["root"]:
+        shutil.rmtree(_E2E_STATE["root"], ignore_errors=True)

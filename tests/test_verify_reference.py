@@ -1,135 +1,219 @@
 """Tests for verify_reference.py — the mechanical round-start gate.
 
-Contract: exactly one JSON line on stdout; exit 0 when the live state
-matches the committed fingerprint, 1 on any drift (reference tree
-non-empty, sidecar hashes changed, SNIPPETS.md appearing), 2 when the
-fingerprint itself is missing or corrupt.
+Contract: exactly one JSON line on stdout; exit codes are distinct per
+failure mode so exit-code-only consumers can never conflate them:
+0 = live state matches the committed fingerprint; 1 = genuine drift
+(reference tree non-empty, sidecar hashes changed, SNIPPETS.md
+appearing); 2 = the fingerprint itself is missing or corrupt;
+3 = transient environment failure (mount absent/unreadable/stale) —
+NOT evidence the reference changed.
+
+A non-empty observed tree must additionally produce a per-file manifest
+(reference_manifest_observed.json) to bootstrap the mandated SURVEY.md
+rewrite, without disturbing the one-line stdout contract.
 """
 
+import hashlib
 import json
 import os
 import pathlib
-import subprocess
-import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-sys.path.insert(0, str(REPO))
-import verify_reference  # noqa: E402
-
-BASELINE_CONTENT = '{"north_star": "non-graftable"}\n'
-PAPERS_CONTENT = "# PAPERS\n"
+import bench
+import verify_reference
 
 
-def make_repo(tmp_path, with_snippets=False):
-    """A fake repo dir whose fingerprint matches its own sidecars."""
-    import hashlib
-
-    repo = tmp_path / "repo"
-    repo.mkdir()
-    (repo / "BASELINE.json").write_text(BASELINE_CONTENT)
-    (repo / "PAPERS.md").write_text(PAPERS_CONTENT)
-    if with_snippets:
-        (repo / "SNIPPETS.md").write_text("# SNIPPETS\n")
-    fingerprint = {
-        "reference_entry_count": 0,
-        "baseline_json_sha256": hashlib.sha256(BASELINE_CONTENT.encode()).hexdigest(),
-        "papers_md_sha256": hashlib.sha256(PAPERS_CONTENT.encode()).hexdigest(),
-        "snippets_md_present": False,
-    }
-    (repo / "reference_fingerprint.json").write_text(json.dumps(fingerprint))
-    return repo
+def run_main(monkeypatch, capsys, reference, repo):
+    """In-process ``python verify_reference.py``; returns (rc, result)."""
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(reference))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(repo))
+    rc = verify_reference.main()
+    captured = capsys.readouterr()
+    assert captured.err == ""
+    return rc, parse_single_json_line(captured.out)
 
 
-def run_verify(reference_path, repo_path):
-    env = dict(os.environ)
-    env["GRAFT_REFERENCE_PATH"] = str(reference_path)
-    env["GRAFT_REPO_PATH"] = str(repo_path)
-    return subprocess.run(
-        [sys.executable, str(REPO / "verify_reference.py")],
-        capture_output=True,
-        text=True,
-        env=env,
-        cwd="/tmp",
-    )
-
-
-def parse_single_json_line(proc):
-    assert proc.stderr == ""
-    lines = proc.stdout.splitlines()
+def parse_single_json_line(stdout_text):
+    lines = stdout_text.splitlines()
     assert len(lines) == 1
     return json.loads(lines[0])
 
 
-def test_empty_reference_matches_fingerprint(tmp_path):
+def test_empty_reference_matches_fingerprint_exits_0(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
     ref = tmp_path / "ref"
     ref.mkdir()
-    proc = run_verify(ref, make_repo(tmp_path))
-    result = parse_single_json_line(proc)
-    assert proc.returncode == 0
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_MATCH == 0
     assert result["reference_empty"] is True
     assert result["matches_fingerprint"] is True
     assert result["drift"] == []
+    assert result["manifest"] is None
+    assert not (fake_repo / verify_reference.MANIFEST_NAME).exists()
 
 
-def test_populated_reference_is_drift(tmp_path):
+def test_populated_reference_is_drift_exits_1(tmp_path, fake_repo, monkeypatch, capsys):
     ref = tmp_path / "ref"
     (ref / "src").mkdir(parents=True)
     (ref / "src" / "main.cu").write_text("// code\n")
-    proc = run_verify(ref, make_repo(tmp_path))
-    result = parse_single_json_line(proc)
-    assert proc.returncode == 1
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT == 1
     assert result["reference_empty"] is False
     assert result["matches_fingerprint"] is False
     assert result["transient_environment_failure"] is False
     assert "DRIFT" in result["note"]
-    drifted = {d["fact"] for d in result["drift"]}
-    assert drifted == {"reference_entry_count"}
+    assert {d["fact"] for d in result["drift"]} == {"reference_entry_count"}
     assert result["observed"]["reference_entry_count"] == 2
 
 
-def test_missing_reference_is_transient_failure_not_drift(tmp_path):
-    proc = run_verify(tmp_path / "gone", make_repo(tmp_path))
-    result = parse_single_json_line(proc)
-    assert proc.returncode == 1
+def test_populated_reference_writes_manifest(tmp_path, fake_repo, monkeypatch, capsys):
+    """The manifest must record every entry (dirs, files, symlinks) with
+    relative path, type, size, and file sha256, sorted by path — the
+    evidence bootstrap for rewriting SURVEY.md from a real tree."""
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+    (ref / "src" / "main.cu").write_text("// code\n")
+    (ref / "README.md").write_text("hello\n")
+    (ref / "link").symlink_to("README.md")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+
+    manifest_path = fake_repo / verify_reference.MANIFEST_NAME
+    assert result["manifest"] == str(manifest_path)
+    assert not list(fake_repo.glob(verify_reference.MANIFEST_NAME + ".*.tmp"))
+    manifest = json.loads(manifest_path.read_text())
+    assert manifest["reference_path"] == str(ref)
+    assert manifest["entry_count"] == 4
+    assert [e["path"] for e in manifest["entries"]] == [
+        "README.md",
+        "link",
+        "src",
+        "src/main.cu",
+    ]
+    by_path = {e["path"]: e for e in manifest["entries"]}
+    assert by_path["src"]["type"] == "dir"
+    assert by_path["link"]["type"] == "symlink"
+    assert by_path["link"]["target"] == "README.md"
+    assert by_path["src/main.cu"]["type"] == "file"
+    assert by_path["src/main.cu"]["size"] == len("// code\n")
+    assert (
+        by_path["src/main.cu"]["sha256"]
+        == hashlib.sha256(b"// code\n").hexdigest()
+    )
+
+
+def test_unwritable_manifest_does_not_break_the_gate(
+    tmp_path, fake_repo, deny_manifest_write, monkeypatch, capsys
+):
+    """If the manifest cannot be written (read-only repo dir), the gate
+    still reports drift with rc 1 and one JSON line; the failure is
+    surfaced as manifest_error instead of a crash, and the note must not
+    point the reader at a manifest that was never written."""
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest"] is None
+    assert result["manifest_error"] == "OSError"
+    assert "manifest" not in result["note"]
+    assert not list(fake_repo.glob(verify_reference.MANIFEST_NAME + "*"))
+
+
+def test_unreadable_file_is_marked_in_manifest(tmp_path, fake_repo, monkeypatch, capsys):
+    """A file whose contents cannot be read must carry an explicit error
+    marker in the manifest — sha256:null alone is indistinguishable from
+    a benign dir/symlink entry, which would make the evidence look
+    complete when it is not."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / "ok.txt").write_text("fine\n")
+    (ref / "broken.txt").write_text("secret\n")
+    (ref / "badlink").symlink_to("ok.txt")
+    real_read_bytes = pathlib.Path.read_bytes
+    real_readlink = os.readlink
+
+    def flaky_read_bytes(self):
+        if self.name == "broken.txt":
+            raise PermissionError("no read access")
+        return real_read_bytes(self)
+
+    def flaky_readlink(path, *args, **kwargs):
+        if pathlib.Path(path).name == "badlink":
+            raise OSError("stale handle")
+        return real_readlink(path, *args, **kwargs)
+
+    monkeypatch.setattr(pathlib.Path, "read_bytes", flaky_read_bytes)
+    monkeypatch.setattr(os, "readlink", flaky_readlink)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    manifest = json.loads(
+        (fake_repo / verify_reference.MANIFEST_NAME).read_text()
+    )
+    by_path = {e["path"]: e for e in manifest["entries"]}
+    assert by_path["broken.txt"]["sha256"] is None
+    assert by_path["broken.txt"]["error"] == "PermissionError"
+    assert by_path["badlink"]["type"] == "symlink"
+    assert by_path["badlink"]["target"] is None
+    assert by_path["badlink"]["error"] == "OSError"
+    assert by_path["ok.txt"]["sha256"] == hashlib.sha256(b"fine\n").hexdigest()
+    assert "error" not in by_path["ok.txt"]
+
+
+def test_matching_nonempty_fingerprint_retires_the_emptiness_note(
+    tmp_path, monkeypatch, capsys
+):
+    """After a deliberate fingerprint update to a re-populated reference,
+    a clean match (rc 0) must not keep claiming the reference is empty."""
+    from conftest import make_fake_repo
+
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+    (ref / "src" / "main.cu").write_text("// code\n")
+    repo = make_fake_repo(tmp_path, entry_count=2)
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_MATCH
+    assert result["matches_fingerprint"] is True
+    assert result["reference_empty"] is False
+    assert "still empty" not in result["note"]
+    assert "NON-EMPTY" in result["note"]
+    assert (repo / verify_reference.MANIFEST_NAME).exists()
+
+
+def test_sidecar_drift_during_mount_outage_is_drift_not_transient(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """Genuine sidecar drift must exit 1 even when the mount is also
+    unscannable this run — rc 3 would hide the drift from exit-code-only
+    consumers, who would just retry the mount forever."""
+    (fake_repo / "PAPERS.md").write_text("# PAPERS\n\nnew retrieved content\n")
+    rc, result = run_main(monkeypatch, capsys, tmp_path / "gone", fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["transient_environment_failure"] is True
+    assert {d["fact"] for d in result["drift"]} == {
+        "papers_md_sha256",
+        "reference_entry_count",
+    }
+    assert "DRIFT" in result["note"]
+    assert "could not be scanned" in result["note"]
+
+
+def test_missing_reference_is_transient_exits_3(tmp_path, fake_repo, monkeypatch, capsys):
+    rc, result = run_main(monkeypatch, capsys, tmp_path / "gone", fake_repo)
+    assert rc == verify_reference.EXIT_TRANSIENT == 3
     assert result["observed"]["reference_entry_count"] == "mount_missing_or_unreadable"
-    # The JSON evidence line must self-describe this as environmental,
-    # not as the reference having changed (SKILL.md semantics).
+    # The exit code and the JSON evidence must both self-describe this as
+    # environmental, not as the reference having changed (SKILL.md).
     assert result["transient_environment_failure"] is True
     assert "TRANSIENT" in result["note"]
+    assert result["manifest"] is None
 
 
-def test_changed_baseline_sidecar_is_drift(tmp_path):
+def test_scan_error_is_transient_exits_3(tmp_path, fake_repo, monkeypatch, capsys):
+    """A mid-walk OSError (via the shared bench.scan) is a transient
+    environment failure with its own exit code, not drift."""
     ref = tmp_path / "ref"
-    ref.mkdir()
-    repo = make_repo(tmp_path)
-    (repo / "BASELINE.json").write_text('{"north_star": "now it has code!"}\n')
-    proc = run_verify(ref, repo)
-    result = parse_single_json_line(proc)
-    assert proc.returncode == 1
-    drifted = {d["fact"] for d in result["drift"]}
-    assert drifted == {"baseline_json_sha256"}
-    # the reference itself is still empty; only the sidecar moved
-    assert result["reference_empty"] is True
-
-
-def test_snippets_appearing_is_drift(tmp_path):
-    ref = tmp_path / "ref"
-    ref.mkdir()
-    repo = make_repo(tmp_path, with_snippets=True)
-    proc = run_verify(ref, repo)
-    result = parse_single_json_line(proc)
-    assert proc.returncode == 1
-    drifted = {d["fact"] for d in result["drift"]}
-    assert drifted == {"snippets_md_present"}
-
-
-def test_scan_error_maps_to_sentinel(tmp_path, monkeypatch):
-    """A mid-walk OSError (via the shared bench.scan) becomes the
-    'scan_error' sentinel, which mismatches the fingerprint's 0 and is
-    documented as a transient environment failure, not a changed tree."""
-
-    bad = tmp_path / "bad"
-    bad.mkdir()
+    bad = ref / "bad"
+    bad.mkdir(parents=True)
     real_scandir = os.scandir
 
     def flaky_scandir(path=".", *args, **kwargs):
@@ -138,71 +222,164 @@ def test_scan_error_maps_to_sentinel(tmp_path, monkeypatch):
         return real_scandir(path, *args, **kwargs)
 
     monkeypatch.setattr(os, "scandir", flaky_scandir)
-    assert verify_reference.count_entries(tmp_path) == "scan_error"
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_TRANSIENT
+    assert result["observed"]["reference_entry_count"] == "scan_error"
+    assert result["transient_environment_failure"] is True
+
+
+def test_changed_baseline_sidecar_is_drift_exits_1(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (fake_repo / "BASELINE.json").write_text('{"north_star": "now it has code!"}\n')
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert {d["fact"] for d in result["drift"]} == {"baseline_json_sha256"}
+    # the reference itself is still empty; only the sidecar moved
+    assert result["reference_empty"] is True
+    assert result["manifest"] is None
+
+
+def test_snippets_appearing_is_drift_exits_1(tmp_path, monkeypatch, capsys):
+    from conftest import make_fake_repo
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    repo = make_fake_repo(tmp_path, with_snippets=True)
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert {d["fact"] for d in result["drift"]} == {"snippets_md_present"}
 
 
 def test_count_entries_delegates_to_bench(tmp_path):
-    """bench.scan and the round-start gate must agree on the same mount."""
+    """bench.scan and the round-start gate must agree on the same mount,
+    including when the caller hands over a precomputed scan result."""
     (tmp_path / "a").mkdir()
     (tmp_path / "a" / "b.txt").write_text("x")
     assert verify_reference.count_entries(tmp_path) == 2
     assert verify_reference.count_entries(tmp_path / "gone") == (
         "mount_missing_or_unreadable"
     )
+    precomputed = bench.scan(tmp_path)
+    assert verify_reference.count_entries(tmp_path, scan_result=precomputed) == 2
 
 
-def test_missing_fingerprint_exits_2(tmp_path):
+def test_missing_fingerprint_exits_2(tmp_path, monkeypatch, capsys):
     ref = tmp_path / "ref"
     ref.mkdir()
     repo = tmp_path / "bare"
     repo.mkdir()
-    proc = run_verify(ref, repo)
-    result = parse_single_json_line(proc)
-    assert proc.returncode == 2
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_FINGERPRINT_CORRUPT == 2
     assert result["error"] == "fingerprint_missing_or_corrupt"
 
 
-def test_corrupt_fingerprint_exits_2(tmp_path):
+def test_corrupt_fingerprint_exits_2(tmp_path, fake_repo, monkeypatch, capsys):
     ref = tmp_path / "ref"
     ref.mkdir()
-    repo = make_repo(tmp_path)
-    (repo / "reference_fingerprint.json").write_text("{not json")
-    proc = run_verify(ref, repo)
-    result = parse_single_json_line(proc)
-    assert proc.returncode == 2
+    (fake_repo / "reference_fingerprint.json").write_text("{not json")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_FINGERPRINT_CORRUPT
+    assert result["error"] == "fingerprint_missing_or_corrupt"
 
 
-def test_non_object_json_fingerprint_exits_2(tmp_path):
+def test_non_object_json_fingerprint_exits_2(tmp_path, fake_repo, monkeypatch, capsys):
     """Valid JSON that is not an object (null, list, scalar) is corrupt,
     not drift: must take the exit-2 path, not crash with rc 1."""
     ref = tmp_path / "ref"
     ref.mkdir()
-    repo = make_repo(tmp_path)
     for payload in ("null", "[]", '"x"', "42"):
-        (repo / "reference_fingerprint.json").write_text(payload)
-        proc = run_verify(ref, repo)
-        result = parse_single_json_line(proc)
-        assert proc.returncode == 2, payload
+        (fake_repo / "reference_fingerprint.json").write_text(payload)
+        rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+        assert rc == verify_reference.EXIT_FINGERPRINT_CORRUPT, payload
         assert result["error"] == "fingerprint_missing_or_corrupt"
 
 
-def test_real_repo_fingerprint_matches_live_mount():
-    """The committed fingerprint must match the real repo sidecars; and
-    unless the driver re-mounted a different reference, the live mount
-    must still be empty."""
-    proc = subprocess.run(
-        [sys.executable, str(REPO / "verify_reference.py")],
-        capture_output=True,
-        text=True,
-        cwd="/tmp",
-    )
-    result = parse_single_json_line(proc)
-    # Sidecar hashes are committed alongside the sidecars, so a mismatch
-    # here is a repo bug (stale fingerprint), not environment drift.
+def test_non_int_fingerprint_count_exits_2(tmp_path, fake_repo, monkeypatch, capsys):
+    """A fingerprint whose reference_entry_count is not a non-negative
+    int is corrupt. Otherwise an error sentinel pasted into the
+    fingerprint (e.g. from an observed block captured during a mount
+    outage) would make every future transient failure 'match' with rc 0
+    and a verdict-retiring note."""
+    fingerprint = json.loads((fake_repo / "reference_fingerprint.json").read_text())
+    for bad_count in ("mount_missing_or_unreadable", "scan_error", None, -1, 1.5, True):
+        fingerprint["reference_entry_count"] = bad_count
+        (fake_repo / "reference_fingerprint.json").write_text(json.dumps(fingerprint))
+        rc, result = run_main(monkeypatch, capsys, tmp_path / "gone", fake_repo)
+        assert rc == verify_reference.EXIT_FINGERPRINT_CORRUPT, bad_count
+        assert result["error"] == "fingerprint_missing_or_corrupt"
+
+
+def test_invalid_fingerprint_sidecar_fields_exit_2(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """Missing/null/mistyped sidecar facts are fingerprint corruption
+    (rc 2: fix the repo), not sidecar drift (rc 1: verdict-affecting
+    workflow) — the same asymmetry guard as for the entry count."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    good = json.loads((fake_repo / "reference_fingerprint.json").read_text())
+    mutations = [
+        ("baseline_json_sha256", None),
+        ("papers_md_sha256", 42),
+        ("snippets_md_present", "no"),
+        ("baseline_json_sha256", "DELETE"),
+    ]
+    for key, value in mutations:
+        fingerprint = dict(good)
+        if value == "DELETE":
+            del fingerprint[key]
+        else:
+            fingerprint[key] = value
+        (fake_repo / "reference_fingerprint.json").write_text(json.dumps(fingerprint))
+        rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+        assert rc == verify_reference.EXIT_FINGERPRINT_CORRUPT, (key, value)
+        assert result["error"] == "fingerprint_missing_or_corrupt"
+
+
+def test_e2e_real_repo_fingerprint_matches_live_mount(e2e):
+    """The documented round-start gate, run exactly as documented
+    (plain ``python verify_reference.py``): the committed fingerprint
+    must match the real repo sidecars, and the live mount must be
+    empty (rc 0) or environmentally unavailable (rc 3). Any other
+    outcome — in particular a NON-EMPTY remounted reference — fails
+    this test loudly: SURVEY.md is then obsolete and must be rewritten
+    from the real tree before any build work."""
+    run = e2e["verify_real"]
+    assert run.err == ""
+    result = parse_single_json_line(run.out)
+    # .get: the rc-2 outcome emits no drift key; the rc assertion below
+    # must then fire with its diagnostic, not a KeyError here.
     sidecar_drift = [
-        d for d in result["drift"] if d["fact"] != "reference_entry_count"
+        d for d in result.get("drift", []) if d["fact"] != "reference_entry_count"
     ]
     assert sidecar_drift == [], (
         "reference_fingerprint.json is stale relative to the committed "
         f"sidecars: {sidecar_drift}"
     )
+    assert run.rc in (
+        verify_reference.EXIT_MATCH,
+        verify_reference.EXIT_TRANSIENT,
+    ), f"unexpected gate outcome rc={run.rc}: {result}"
+    if run.rc == verify_reference.EXIT_MATCH:
+        assert result["matches_fingerprint"] is True
+        assert result["observed"]["reference_entry_count"] == 0
+    else:
+        assert result["transient_environment_failure"] is True
+
+
+def test_e2e_populated_reference_drift(e2e):
+    """End-to-end subprocess run against a populated mount: rc 1, one
+    JSON line, manifest written — through the real exit-code plumbing
+    that round-start scripts consume."""
+    run = e2e["verify_populated"]
+    assert run.rc == verify_reference.EXIT_DRIFT
+    assert run.err == ""
+    result = parse_single_json_line(run.out)
+    assert "DRIFT" in result["note"]
+    assert result["observed"]["reference_entry_count"] == 3
+    manifest_path = run.repo / verify_reference.MANIFEST_NAME
+    assert manifest_path.exists()
+    assert json.loads(manifest_path.read_text())["entry_count"] == 3

@@ -3,12 +3,10 @@
 The single load-bearing fact of this repository is that the upstream
 `mark1222/arena` tree mounted at /root/reference contains zero files
 (SURVEY.md), which makes the repo non-graftable (NON_GRAFTABLE.md,
-BASELINE.json north star). Rounds 1-2 re-established that fact by
-hand-run checklists; this script makes the gate mechanical, per
-VERDICT.md "Next round" items 1, 4 and 5.
-
-It re-runs the SURVEY.md verification checks and compares the results
-against the committed fingerprint (reference_fingerprint.json):
+BASELINE.json north star). This script makes the round-start gate
+mechanical: it re-runs the SURVEY.md verification checks and compares
+the results against the committed fingerprint
+(reference_fingerprint.json):
 
 - recursive entry count under the reference mount (guarded against the
   mount going stale mid-walk);
@@ -23,11 +21,32 @@ against the committed fingerprint (reference_fingerprint.json):
   only the mounted tree defines capabilities).
 
 Output: exactly ONE JSON line on stdout with the evidence and a `drift`
-list. Exit codes: 0 = everything matches the fingerprint (reference
-still empty, sidecars unchanged); 1 = drift detected (reference
-non-empty or changed sidecars — SURVEY.md may be obsolete; rewrite it
-from the real tree before writing any code); 2 = could not gather
-evidence (fingerprint missing/corrupt).
+list. Exit codes (each failure mode distinct, so exit-code-only
+consumers — a `set -e` round-start script, a driver hook — can never
+misread one as another):
+
+- 0  everything matches the fingerprint: reference still empty,
+     sidecars unchanged; the non-graftable verdict stands.
+- 1  genuine drift: the reference tree is non-empty or the sidecars
+     changed. If the tree is non-empty, SURVEY.md is obsolete —
+     rewrite it from the real tree before writing any code.
+- 2  could not gather evidence: fingerprint missing or corrupt
+     (repo bug, fix the fingerprint).
+- 3  transient environment failure: the mount is absent, unreadable,
+     or went stale mid-walk. This is NOT evidence the reference
+     changed — there is no tree to re-survey; investigate the mount
+     and re-run.
+
+When a non-empty tree is observed, a per-file manifest (relative path,
+type, size, sha256) is additionally written to
+`reference_manifest_observed.json` in the repo directory — evidence to
+bootstrap the mandated SURVEY.md rewrite, so the obsolescence path
+starts from facts instead of a blank page. stdout stays one JSON line.
+
+The core comparison lives in `verify(reference, repo)` so bench.py can
+embed the same evidence in the driver's mandatory bench line every
+round (sidecar drift must never depend on a human remembering to run
+this script).
 
 Paths are overridable for tests: GRAFT_REFERENCE_PATH (mount) and
 GRAFT_REPO_PATH (directory holding the fingerprint and sidecars).
@@ -37,18 +56,27 @@ import hashlib
 import json
 import os
 import pathlib
+import stat as stat_module
 import sys
+import tempfile
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
 import bench  # the accessibility check + guarded walk live in ONE place
 
 DEFAULT_REFERENCE = "/root/reference"
+FINGERPRINT_NAME = "reference_fingerprint.json"
+MANIFEST_NAME = "reference_manifest_observed.json"
 COMPARED_KEYS = (
     "reference_entry_count",
     "baseline_json_sha256",
     "papers_md_sha256",
     "snippets_md_present",
 )
+
+EXIT_MATCH = 0
+EXIT_DRIFT = 1
+EXIT_FINGERPRINT_CORRUPT = 2
+EXIT_TRANSIENT = 3
 
 
 def sha256_of(path: pathlib.Path):
@@ -58,17 +86,23 @@ def sha256_of(path: pathlib.Path):
         return None
 
 
-def count_entries(reference: pathlib.Path):
+def count_entries(reference: pathlib.Path, scan_result: dict = None):
     """Recursive entry count, or an error-string sentinel on failure.
 
     Delegates to bench.scan() so the mount-accessibility check and the
     OSError-guarded walk exist in exactly one place; bench and this gate
-    can never disagree about whether the same mount is empty.
+    can never disagree about whether the same mount is empty. A caller
+    that already ran bench.scan() (bench.main embedding verification)
+    passes its result via scan_result so the counting walk is not
+    repeated. (A non-empty observation still triggers a separate
+    traversal for the manifest — see write_manifest, which derives its
+    entry_count from its own walk for exactly that reason.)
     """
-    result = bench.scan(reference)
-    if result["metric"] == "non_graftable_reference_is_empty":
+    result = scan_result if scan_result is not None else bench.scan(reference)
+    metric = result["metric"]
+    if metric in ("non_graftable_reference_is_empty", "reference_tree_non_empty"):
         return result["value"]
-    if result["metric"] == "reference_scan_error":
+    if metric == "reference_scan_error":
         return "scan_error"
     return "mount_missing_or_unreadable"
 
@@ -87,51 +121,183 @@ def mount_stat(reference: pathlib.Path):
         return {"error": exc.__class__.__name__}
 
 
-def gather(reference: pathlib.Path, repo: pathlib.Path) -> dict:
+def gather(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None) -> dict:
     return {
-        "reference_entry_count": count_entries(reference),
+        "reference_entry_count": count_entries(reference, scan_result),
         "baseline_json_sha256": sha256_of(repo / "BASELINE.json"),
         "papers_md_sha256": sha256_of(repo / "PAPERS.md"),
         "snippets_md_present": (repo / "SNIPPETS.md").exists(),
     }
 
 
-def main() -> int:
-    reference = pathlib.Path(os.environ.get("GRAFT_REFERENCE_PATH", DEFAULT_REFERENCE))
-    repo = pathlib.Path(
-        os.environ.get("GRAFT_REPO_PATH", pathlib.Path(__file__).resolve().parent)
-    )
-
+def _manifest_entry(path: pathlib.Path, root: pathlib.Path) -> dict:
+    rel = path.relative_to(root).as_posix()
     try:
-        fingerprint = json.loads((repo / "reference_fingerprint.json").read_text())
+        st = path.lstat()
+    except OSError as exc:
+        return {"path": rel, "type": "error", "error": exc.__class__.__name__}
+    if stat_module.S_ISLNK(st.st_mode):
+        entry = {"path": rel, "type": "symlink", "size": st.st_size, "sha256": None}
+        try:
+            entry["target"] = os.readlink(path)
+        except OSError as exc:
+            # Unreadable must be visibly unreadable, same as the file branch.
+            entry["target"] = None
+            entry["error"] = exc.__class__.__name__
+        return entry
+    if stat_module.S_ISDIR(st.st_mode):
+        return {"path": rel, "type": "dir", "size": None, "sha256": None}
+    try:
+        digest = hashlib.sha256(path.read_bytes()).hexdigest()
+    except OSError as exc:
+        # An unreadable file must be visibly unreadable in the evidence,
+        # not shaped like a dir/symlink's benign sha256:null.
+        return {
+            "path": rel,
+            "type": "file",
+            "size": st.st_size,
+            "sha256": None,
+            "error": exc.__class__.__name__,
+        }
+    return {"path": rel, "type": "file", "size": st.st_size, "sha256": digest}
+
+
+def build_manifest(reference: pathlib.Path) -> list:
+    """Per-entry facts for an observed non-empty tree, sorted by path.
+
+    Iterates bench.guarded_walk, so it shares the count's exact
+    traversal semantics: directory symlinks are not followed (a
+    symlinked subtree is recorded as one symlink entry) and scandir
+    failures raise rather than silently truncating the evidence.
+    """
+    entries = []
+    for dirpath, dirnames, filenames in bench.guarded_walk(reference):
+        base = pathlib.Path(dirpath)
+        for name in dirnames + filenames:
+            entries.append(_manifest_entry(base / name, reference))
+    entries.sort(key=lambda entry: entry["path"])
+    return entries
+
+
+def write_manifest(reference: pathlib.Path, repo: pathlib.Path) -> str:
+    """Write the manifest; its entry_count is derived from its own walk
+    (the mount may have changed between the counting walk and this one,
+    so the evidence file must be internally consistent).
+
+    Written atomically (per-process unique temp file + os.replace):
+    concurrent gate runs (e.g. bench and verify_reference in the same
+    round) or a crash mid-write must never leave truncated JSON in the
+    evidence file.
+    """
+    manifest_path = repo / MANIFEST_NAME
+    entries = build_manifest(reference)
+    payload = {
+        "comment": (
+            "A NON-EMPTY reference tree was observed. SURVEY.md (which "
+            "surveyed an empty tree) is obsolete and must be rewritten "
+            "from this real tree before any build work; this manifest is "
+            "the evidence to start that rewrite from. Only the mounted "
+            "tree defines capabilities."
+        ),
+        "reference_path": str(reference),
+        "entry_count": len(entries),
+        "entries": entries,
+    }
+    fd, tmp_name = tempfile.mkstemp(
+        dir=repo, prefix=MANIFEST_NAME + ".", suffix=".tmp"
+    )
+    os.fchmod(fd, 0o644)  # mkstemp's 0600 would survive os.replace
+    os.close(fd)
+    tmp_path = pathlib.Path(tmp_name)
+    try:
+        tmp_path.write_text(json.dumps(payload, indent=2) + "\n")
+        os.replace(tmp_path, manifest_path)
+    except OSError:
+        try:
+            tmp_path.unlink()
+        except OSError:
+            pass
+        raise
+    return str(manifest_path)
+
+
+def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None):
+    """Compare the live mount + sidecars to the committed fingerprint.
+
+    Returns (result_dict, exit_code) — the JSON-serializable evidence
+    and the exit code documented in the module docstring. Used by
+    main() and embedded by bench.main() into the driver's bench line;
+    scan_result lets bench pass its own scan() so the mount is walked
+    once per invocation.
+    """
+    fingerprint_path = repo / FINGERPRINT_NAME
+    try:
+        fingerprint = json.loads(fingerprint_path.read_text())
         if not isinstance(fingerprint, dict):
             raise ValueError("fingerprint must be a JSON object")
+        fingerprint_count = fingerprint.get("reference_entry_count")
+        # A non-int count (e.g. an error sentinel pasted from an observed
+        # block during a mount outage) would make every future transient
+        # failure "match" with rc 0 — treat it as a corrupt fingerprint.
+        if (
+            not isinstance(fingerprint_count, int)
+            or isinstance(fingerprint_count, bool)
+            or fingerprint_count < 0
+        ):
+            raise ValueError("reference_entry_count must be a non-negative int")
+        # Same defense for the sidecar facts: a missing/null/mistyped key
+        # is a corrupt fingerprint (rc 2, fix the repo), not "the sidecars
+        # drifted" (rc 1, a verdict-affecting workflow).
+        for key in ("baseline_json_sha256", "papers_md_sha256"):
+            if not isinstance(fingerprint.get(key), str):
+                raise ValueError(f"{key} must be a string")
+        if not isinstance(fingerprint.get("snippets_md_present"), bool):
+            raise ValueError("snippets_md_present must be a bool")
     except (OSError, ValueError):
-        print(
-            json.dumps(
-                {
-                    "check": "reference_verification",
-                    "error": "fingerprint_missing_or_corrupt",
-                    "fingerprint_path": str(repo / "reference_fingerprint.json"),
-                }
-            )
+        return (
+            {
+                "check": "reference_verification",
+                "error": "fingerprint_missing_or_corrupt",
+                "fingerprint_path": str(fingerprint_path),
+            },
+            EXIT_FINGERPRINT_CORRUPT,
         )
-        return 2
 
-    observed = gather(reference, repo)
+    observed = gather(reference, repo, scan_result)
     drift = [
         {"fact": key, "fingerprint": fingerprint.get(key), "observed": observed[key]}
         for key in COMPARED_KEYS
         if observed[key] != fingerprint.get(key)
     ]
-    transient = observed["reference_entry_count"] in (
-        "mount_missing_or_unreadable",
-        "scan_error",
-    )
+    count = observed["reference_entry_count"]
+    transient = count in ("mount_missing_or_unreadable", "scan_error")
+
+    manifest = None
+    manifest_error = None
+    if isinstance(count, int) and count > 0:
+        try:
+            manifest = write_manifest(reference, repo)
+        except OSError as exc:
+            manifest_error = exc.__class__.__name__
+
+    non_count_drift = [d for d in drift if d["fact"] != "reference_entry_count"]
 
     if not drift:
-        note = "reference still empty; non-graftable verdict stands"
-    elif transient:
+        exit_code = EXIT_MATCH
+        if count == 0:
+            note = "reference still empty; non-graftable verdict stands"
+        else:
+            # Reachable only after a deliberate fingerprint update to a
+            # re-populated reference: a match must not keep endorsing the
+            # old emptiness claim.
+            note = (
+                f"matches fingerprint, which records a NON-EMPTY tree "
+                f"({count} entries): the non-graftable verdict no longer "
+                "applies — build against the surveyed tree."
+                + (" See the manifest." if manifest is not None else "")
+            )
+    elif transient and not non_count_drift:
+        exit_code = EXIT_TRANSIENT
         note = (
             "TRANSIENT ENVIRONMENT FAILURE: the mount could not be scanned "
             "(absent, unreadable, or going stale mid-walk). This is NOT "
@@ -139,27 +305,54 @@ def main() -> int:
             "Investigate the mount / re-run; do not touch SURVEY.md."
         )
     else:
+        # Sidecar drift is genuine drift even when the mount is also
+        # unscannable this run — rc 3 must never mask it from
+        # exit-code-only consumers.
+        exit_code = EXIT_DRIFT
         note = (
             "DRIFT: the surveyed state changed. If the reference tree is "
             "non-empty, SURVEY.md is obsolete — rewrite it from the real tree "
-            "before writing any code. Sidecar-only drift (PAPERS/SNIPPETS) "
-            "does not add capabilities: only the mounted tree defines what "
-            "to build."
+            "before writing any code"
+            + (
+                " (see the manifest for the observed entries)"
+                if manifest is not None
+                else ""
+            )
+            + ". Sidecar-only drift (PAPERS/SNIPPETS) does not add "
+            "capabilities: only the mounted tree defines what to build."
         )
+        if transient:
+            note += (
+                " NOTE: the mount itself could not be scanned this run "
+                "(transient environment failure), so only the sidecar drift "
+                "is confirmed; re-run once the mount is back."
+            )
 
     result = {
         "check": "reference_verification",
         "reference_path": str(reference),
-        "reference_empty": observed["reference_entry_count"] == 0,
+        "reference_empty": count == 0,
         "matches_fingerprint": not drift,
         "transient_environment_failure": transient,
         "drift": drift,
         "observed": observed,
         "mount_stat": mount_stat(reference),
+        "manifest": manifest,
         "note": note,
     }
+    if manifest_error is not None:
+        result["manifest_error"] = manifest_error
+    return result, exit_code
+
+
+def main() -> int:
+    reference = pathlib.Path(os.environ.get("GRAFT_REFERENCE_PATH", DEFAULT_REFERENCE))
+    repo = pathlib.Path(
+        os.environ.get("GRAFT_REPO_PATH", pathlib.Path(__file__).resolve().parent)
+    )
+    result, exit_code = verify(reference, repo)
     print(json.dumps(result))
-    return 0 if not drift else 1
+    return exit_code
 
 
 if __name__ == "__main__":
