@@ -34,7 +34,10 @@ The JSON line also embeds a ``verification`` object — the fingerprint
 comparison from verify_reference.verify() — because this is the one
 command the driver provably runs every round: reference remounts and
 sidecar drift (PAPERS.md/SNIPPETS.md/BASELINE.json changing) land in
-BENCH_r*.json automatically, with no human in the loop. The embedding is
+BENCH_r*.json automatically, with no human in the loop. The summary
+carries the gate's human-facing ``note`` so the artifact self-describes
+without the SKILL.md exit-code table, and surfaces uncommitted driver
+round artifacts when the hygiene check finds any. The embedding is
 best-effort: any failure inside verification degrades to an ``error``
 field and can never break the one-line / rc-0 contract.
 
@@ -51,6 +54,23 @@ import sys
 
 DEFAULT_REFERENCE = "/root/reference"
 _REPO_DIR = pathlib.Path(__file__).resolve().parent
+
+
+def exc_detail(exc: BaseException, limit: int = 200) -> str:
+    """Class name plus truncated message for error-degradation fields.
+
+    The message matters: `manifest_error: "OSError"` alone cannot
+    distinguish a stale-mount read failure from a write failure, and an
+    errno/path is exactly what the investigating session needs.
+    json.dumps escapes newlines, so embedding this in the one-line
+    stdout contract is safe; truncation keeps a pathological message
+    from bloating the line. Lives here (not verify_reference) because
+    the import dependency is bench <- verify_reference.
+    """
+    message = str(exc)
+    if not message:
+        return exc.__class__.__name__
+    return f"{exc.__class__.__name__}: {message}"[:limit]
 
 
 def guarded_walk(reference: pathlib.Path):
@@ -137,13 +157,26 @@ def verification_summary(reference: pathlib.Path, repo: pathlib.Path, scan_resul
                 "transient_environment_failure"
             ]
             summary["drift"] = result["drift"]
+            if result.get("sidecar_errors"):
+                summary["sidecar_errors"] = result["sidecar_errors"]
             if result.get("manifest") is not None:
                 summary["manifest"] = result["manifest"]
             if "manifest_error" in result:
                 summary["manifest_error"] = result["manifest_error"]
+            # Round-artifact hygiene: only worth a line in the driver
+            # artifact when something is actually uncommitted.
+            if result.get("uncommitted_round_artifacts"):
+                summary["uncommitted_round_artifacts"] = result[
+                    "uncommitted_round_artifacts"
+                ]
+        # The human-facing explanation, so BENCH_r*.json — the one
+        # artifact provably recorded every round — self-describes
+        # without cross-referencing the SKILL.md exit-code table.
+        if "note" in result:
+            summary["note"] = result["note"]
         return summary
     except Exception as exc:  # the one-line / rc-0 contract outranks evidence
-        return {"error": "verification_unavailable", "detail": exc.__class__.__name__}
+        return {"error": "verification_unavailable", "detail": exc_detail(exc)}
 
 
 def main() -> int:

@@ -45,7 +45,12 @@ def make_fake_repo(
     with_snippets: bool = False,
     entry_count: int = 0,
 ):
-    """A fake repo dir whose fingerprint matches its own sidecars."""
+    """A fake repo dir whose fingerprint matches its own sidecars.
+
+    The fingerprint always pins SNIPPETS.md as "absent" (the rounds-1-3
+    upstream state); with_snippets=True creates the file anyway, i.e. a
+    sidecar-appeared drift scenario.
+    """
     repo = root / name
     repo.mkdir(parents=True)
     (repo / "BASELINE.json").write_text(BASELINE_CONTENT)
@@ -56,7 +61,7 @@ def make_fake_repo(
         "reference_entry_count": entry_count,
         "baseline_json_sha256": hashlib.sha256(BASELINE_CONTENT.encode()).hexdigest(),
         "papers_md_sha256": hashlib.sha256(PAPERS_CONTENT.encode()).hexdigest(),
-        "snippets_md_present": False,
+        "snippets_md_sha256": "absent",
     }
     (repo / "reference_fingerprint.json").write_text(json.dumps(fingerprint))
     return repo
@@ -77,7 +82,14 @@ def fake_repo(tmp_path):
 
 
 def _clean_env(**overrides):
-    env = {k: v for k, v in os.environ.items() if not k.startswith("GRAFT_")}
+    """os.environ minus GRAFT_* (test overrides) and GIT_* (a hook's
+    GIT_DIR/GIT_INDEX_FILE would skew the hygiene check; the fake-repo
+    runs re-add GIT_CEILING_DIRECTORIES explicitly)."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not (k.startswith("GRAFT_") or k.startswith("GIT_"))
+    }
     env.update(overrides)
     return env
 
@@ -111,6 +123,9 @@ def _launch_e2e():
                 _clean_env(
                     GRAFT_REFERENCE_PATH=str(bench_ref),
                     GRAFT_REPO_PATH=str(bench_repo),
+                    # Pin "fake repo is not inside a git work tree" even
+                    # when TMPDIR sits inside a checkout.
+                    GIT_CEILING_DIRECTORIES=str(root),
                 ),
                 site=False,
             ),
@@ -126,6 +141,7 @@ def _launch_e2e():
                 _clean_env(
                     GRAFT_REFERENCE_PATH=str(verify_ref),
                     GRAFT_REPO_PATH=str(verify_repo),
+                    GIT_CEILING_DIRECTORIES=str(root),
                 ),
                 site=False,
             ),

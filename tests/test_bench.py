@@ -32,6 +32,9 @@ def run_main(monkeypatch, capsys, reference, repo):
     """In-process ``python bench.py`` with the contract asserted."""
     monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(reference))
     monkeypatch.setenv("GRAFT_REPO_PATH", str(repo))
+    # Pin the hygiene check's "not a git repo" state for fake repos even
+    # when TMPDIR sits inside a checkout (see test_verify_reference).
+    monkeypatch.setenv("GIT_CEILING_DIRECTORIES", str(pathlib.Path(repo).parent))
     rc = bench.main()
     captured = capsys.readouterr()
     assert rc == 0
@@ -57,9 +60,16 @@ def test_empty_reference(tmp_path, fake_repo, monkeypatch, capsys):
     result = run_main(monkeypatch, capsys, empty, fake_repo)
     assert result["metric"] == "non_graftable_reference_is_empty"
     assert result["value"] == 0
-    assert result["verification"]["exit_code"] == verify_reference.EXIT_MATCH
-    assert result["verification"]["matches_fingerprint"] is True
-    assert result["verification"]["drift"] == []
+    verification = result["verification"]
+    assert verification["exit_code"] == verify_reference.EXIT_MATCH
+    assert verification["matches_fingerprint"] is True
+    assert verification["drift"] == []
+    # The human-facing explanation rides along so BENCH_r*.json
+    # self-describes without the SKILL.md exit-code table.
+    assert verification["note"] == "reference still empty; non-graftable verdict stands"
+    # Hygiene field only appears when something is uncommitted (the fake
+    # repo is not a git work tree, so the check degrades to null → omitted).
+    assert "uncommitted_round_artifacts" not in verification
 
 
 def test_populated_reference(tmp_path, fake_repo, monkeypatch, capsys):
@@ -78,6 +88,7 @@ def test_populated_reference(tmp_path, fake_repo, monkeypatch, capsys):
     assert verification["matches_fingerprint"] is False
     assert verification["transient_environment_failure"] is False
     assert {d["fact"] for d in verification["drift"]} == {"reference_entry_count"}
+    assert "DRIFT" in verification["note"]
     assert pathlib.Path(verification["manifest"]).read_text()  # manifest written
 
 
@@ -178,7 +189,7 @@ def test_broken_verification_cannot_break_contract(
     assert result["metric"] == "non_graftable_reference_is_empty"
     assert result["verification"] == {
         "error": "verification_unavailable",
-        "detail": "RuntimeError",
+        "detail": "RuntimeError: verification exploded",
     }
 
 
@@ -189,10 +200,10 @@ def test_fingerprint_corrupt_surfaces_in_verification(
     empty = tmp_path / "empty"
     empty.mkdir()
     result = run_main(monkeypatch, capsys, empty, fake_repo)
-    assert result["verification"] == {
-        "exit_code": verify_reference.EXIT_FINGERPRINT_CORRUPT,
-        "error": "fingerprint_missing_or_corrupt",
-    }
+    verification = result["verification"]
+    assert verification["exit_code"] == verify_reference.EXIT_FINGERPRINT_CORRUPT
+    assert verification["error"] == "fingerprint_missing_or_corrupt"
+    assert "repo bug" in verification["note"]
 
 
 def test_manifest_error_surfaces_in_bench_line(
@@ -207,7 +218,54 @@ def test_manifest_error_surfaces_in_bench_line(
     verification = result["verification"]
     assert verification["exit_code"] == verify_reference.EXIT_DRIFT
     assert "manifest" not in verification
-    assert verification["manifest_error"] == "OSError"
+    assert verification["manifest_error"] == "OSError: read-only file system"
+
+
+def test_unreadable_sidecar_surfaces_as_transient_in_bench_line(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """bench's embedded verification must carry the same sidecar
+    transient discipline as the gate: an unreadable sidecar shows exit
+    code 3 with the read-failure detail, never a false match or false
+    drift — while bench's own one-line/rc-0 contract holds."""
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    real_os_open = os.open
+
+    def deny(target, *args, **kwargs):
+        if pathlib.Path(target).name == "PAPERS.md":
+            raise PermissionError(13, "Permission denied")
+        return real_os_open(target, *args, **kwargs)
+
+    monkeypatch.setattr(os, "open", deny)
+    result = run_main(monkeypatch, capsys, empty, fake_repo)
+    assert result["metric"] == "non_graftable_reference_is_empty"
+    verification = result["verification"]
+    assert verification["exit_code"] == verify_reference.EXIT_TRANSIENT
+    assert verification["matches_fingerprint"] is False
+    assert verification["transient_environment_failure"] is True
+    assert verification["sidecar_errors"]["papers_md_sha256"].startswith(
+        "PermissionError"
+    )
+    assert "TRANSIENT" in verification["note"]
+
+
+def test_uncommitted_round_artifacts_surface_in_bench_line(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """When the hygiene check finds uncommitted driver artifacts, they
+    ride along in the bench line — the one artifact provably recorded
+    every round."""
+    import subprocess
+
+    subprocess.run(
+        ["git", "-C", str(fake_repo), "init", "-q"], check=True, capture_output=True
+    )
+    (fake_repo / "BENCH_r09.json").write_text("{}\n")
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    result = run_main(monkeypatch, capsys, empty, fake_repo)
+    assert "BENCH_r09.json" in result["verification"]["uncommitted_round_artifacts"]
 
 
 def test_e2e_real_mount_contract(e2e):

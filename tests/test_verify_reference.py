@@ -3,10 +3,12 @@
 Contract: exactly one JSON line on stdout; exit codes are distinct per
 failure mode so exit-code-only consumers can never conflate them:
 0 = live state matches the committed fingerprint; 1 = genuine drift
-(reference tree non-empty, sidecar hashes changed, SNIPPETS.md
-appearing); 2 = the fingerprint itself is missing or corrupt;
-3 = transient environment failure (mount absent/unreadable/stale) —
-NOT evidence the reference changed.
+(reference tree non-empty, sidecar content changed, a sidecar appearing
+or disappearing); 2 = the fingerprint itself is missing or corrupt;
+3 = transient environment failure (mount absent/unreadable/stale, or a
+sidecar that exists but cannot be read) — NOT evidence the surveyed
+state changed; 4 = the gate itself crashed (never conflated with
+drift's rc 1).
 
 A non-empty observed tree must additionally produce a per-file manifest
 (reference_manifest_observed.json) to bootstrap the mandated SURVEY.md
@@ -17,6 +19,9 @@ import hashlib
 import json
 import os
 import pathlib
+import time
+
+import pytest
 
 import bench
 import verify_reference
@@ -26,6 +31,10 @@ def run_main(monkeypatch, capsys, reference, repo):
     """In-process ``python verify_reference.py``; returns (rc, result)."""
     monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(reference))
     monkeypatch.setenv("GRAFT_REPO_PATH", str(repo))
+    # Pin the hygiene check's "not a git repo" state: without a ceiling,
+    # a TMPDIR inside any checkout would make git discover the enclosing
+    # work tree from the fake repo dir.
+    monkeypatch.setenv("GIT_CEILING_DIRECTORIES", str(pathlib.Path(repo).parent))
     rc = verify_reference.main()
     captured = capsys.readouterr()
     assert captured.err == ""
@@ -114,8 +123,10 @@ def test_unwritable_manifest_does_not_break_the_gate(
     rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
     assert rc == verify_reference.EXIT_DRIFT
     assert result["manifest"] is None
-    assert result["manifest_error"] == "OSError"
-    assert "manifest" not in result["note"]
+    # Class plus message: "OSError" alone cannot distinguish a write
+    # failure from a stale-mount read failure.
+    assert result["manifest_error"] == "OSError: read-only file system"
+    assert "manifest for the observed entries" not in result["note"]
     assert not list(fake_repo.glob(verify_reference.MANIFEST_NAME + "*"))
 
 
@@ -151,10 +162,10 @@ def test_unreadable_file_is_marked_in_manifest(tmp_path, fake_repo, monkeypatch,
     )
     by_path = {e["path"]: e for e in manifest["entries"]}
     assert by_path["broken.txt"]["sha256"] is None
-    assert by_path["broken.txt"]["error"] == "PermissionError"
+    assert by_path["broken.txt"]["error"] == "PermissionError: no read access"
     assert by_path["badlink"]["type"] == "symlink"
     assert by_path["badlink"]["target"] is None
-    assert by_path["badlink"]["error"] == "OSError"
+    assert by_path["badlink"]["error"] == "OSError: stale handle"
     assert by_path["ok.txt"]["sha256"] == hashlib.sha256(b"fine\n").hexdigest()
     assert "error" not in by_path["ok.txt"]
 
@@ -250,7 +261,10 @@ def test_snippets_appearing_is_drift_exits_1(tmp_path, monkeypatch, capsys):
     repo = make_fake_repo(tmp_path, with_snippets=True)
     rc, result = run_main(monkeypatch, capsys, ref, repo)
     assert rc == verify_reference.EXIT_DRIFT
-    assert {d["fact"] for d in result["drift"]} == {"snippets_md_present"}
+    assert {d["fact"] for d in result["drift"]} == {"snippets_md_sha256"}
+    (drift_entry,) = result["drift"]
+    assert drift_entry["fingerprint"] == "absent"
+    assert drift_entry["observed"] == hashlib.sha256(b"# SNIPPETS\n").hexdigest()
 
 
 def test_count_entries_delegates_to_bench(tmp_path):
@@ -317,14 +331,18 @@ def test_invalid_fingerprint_sidecar_fields_exit_2(
 ):
     """Missing/null/mistyped sidecar facts are fingerprint corruption
     (rc 2: fix the repo), not sidecar drift (rc 1: verdict-affecting
-    workflow) — the same asymmetry guard as for the entry count."""
+    workflow) — the same asymmetry guard as for the entry count. A
+    pinned "unreadable" is corrupt too: it would make every future
+    transient read failure 'match' with rc 0."""
     ref = tmp_path / "ref"
     ref.mkdir()
     good = json.loads((fake_repo / "reference_fingerprint.json").read_text())
     mutations = [
         ("baseline_json_sha256", None),
         ("papers_md_sha256", 42),
-        ("snippets_md_present", "no"),
+        ("snippets_md_sha256", True),
+        ("snippets_md_sha256", "unreadable"),
+        ("papers_md_sha256", "not-a-hex-digest"),
         ("baseline_json_sha256", "DELETE"),
     ]
     for key, value in mutations:
@@ -337,6 +355,321 @@ def test_invalid_fingerprint_sidecar_fields_exit_2(
         rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
         assert rc == verify_reference.EXIT_FINGERPRINT_CORRUPT, (key, value)
         assert result["error"] == "fingerprint_missing_or_corrupt"
+
+
+@pytest.mark.parametrize(
+    "filename,fact",
+    [
+        ("BASELINE.json", "baseline_json_sha256"),
+        ("PAPERS.md", "papers_md_sha256"),
+        ("SNIPPETS.md", "snippets_md_sha256"),
+    ],
+)
+def test_unreadable_sidecar_is_transient_exits_3(
+    tmp_path, fake_repo, monkeypatch, capsys, filename, fact
+):
+    """An OSError reading a sidecar means its true state is UNKNOWN:
+    rc 3 (transient), never rc 1 (false drift) and never rc 0 (false
+    match). For SNIPPETS.md this is the present-but-unreadable case a
+    Path.exists() check would have silently collapsed into 'absent' —
+    a false rc-0 match against a fingerprint that pins absence."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    if not (fake_repo / filename).exists():
+        (fake_repo / filename).write_text("present but unreadable\n")
+    real_os_open = os.open
+
+    def deny(target, *args, **kwargs):
+        if pathlib.Path(target).name == filename:
+            raise PermissionError(13, "Permission denied")
+        return real_os_open(target, *args, **kwargs)
+
+    monkeypatch.setattr(os, "open", deny)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_TRANSIENT
+    assert result["matches_fingerprint"] is False
+    assert result["transient_environment_failure"] is True
+    assert result["observed"][fact] == "unreadable"
+    assert {d["fact"] for d in result["drift"]} == {fact}
+    assert result["sidecar_errors"][fact].startswith("PermissionError")
+    assert "TRANSIENT" in result["note"]
+    assert filename in result["note"]
+
+
+def test_sidecar_disappearing_is_drift_exits_1(tmp_path, fake_repo, monkeypatch, capsys):
+    """A genuinely absent sidecar (ENOENT) is a real content fact, not a
+    read failure: deletion relative to the fingerprint is drift and must
+    not hide behind the transient exit code."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (fake_repo / "PAPERS.md").unlink()
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["transient_environment_failure"] is False
+    assert {d["fact"] for d in result["drift"]} == {"papers_md_sha256"}
+    (drift_entry,) = result["drift"]
+    assert drift_entry["observed"] == "absent"
+
+
+def test_genuine_drift_with_unreadable_sidecar_still_exits_1(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """Confirmed drift outranks a concurrent transient sidecar failure
+    (same precedence as the mount-outage case); the note must flag the
+    unreadable sidecar as not-confirmed rather than folding it into the
+    drift verdict."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (fake_repo / "BASELINE.json").write_text('{"north_star": "changed"}\n')
+    real_os_open = os.open
+
+    def deny(target, *args, **kwargs):
+        if pathlib.Path(target).name == "PAPERS.md":
+            raise OSError(5, "Input/output error")
+        return real_os_open(target, *args, **kwargs)
+
+    monkeypatch.setattr(os, "open", deny)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["transient_environment_failure"] is True
+    assert {d["fact"] for d in result["drift"]} == {
+        "baseline_json_sha256",
+        "papers_md_sha256",
+    }
+    assert result["observed"]["papers_md_sha256"] == "unreadable"
+    assert "DRIFT" in result["note"]
+    assert "not confirmed" in result["note"]
+    assert "PAPERS.md" in result["note"]
+
+
+def test_gate_crash_exits_4_not_1(tmp_path, fake_repo, monkeypatch, capsys):
+    """An unhandled exception must not escape with Python's default exit
+    status 1 — that collides with EXIT_DRIFT, so an exit-code-only
+    consumer would read a gate crash as 'genuine drift'. The catch-all
+    prints one JSON error line and returns the distinct rc 4."""
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("gate exploded")
+
+    monkeypatch.setattr(verify_reference, "verify", boom)
+    rc, result = run_main(monkeypatch, capsys, tmp_path, fake_repo)
+    assert rc == verify_reference.EXIT_INTERNAL_ERROR == 4
+    assert result["error"] == "internal_error"
+    assert result["detail"] == "RuntimeError: gate exploded"
+    assert "repo bug" in result["note"]
+
+
+def test_stale_manifest_tmp_files_are_swept(tmp_path, fake_repo, monkeypatch, capsys):
+    """Temp files orphaned by a crash between mkstemp and os.replace in
+    an earlier run are cleaned up by the next manifest write instead of
+    accumulating forever — but only OLD ones: a fresh temp file may
+    belong to a concurrent run mid-write (bench and the gate can race in
+    the same round), and unlinking it would break that run's atomic
+    write."""
+    orphaned = fake_repo / (verify_reference.MANIFEST_NAME + ".orphan0.tmp")
+    orphaned.write_text("{truncated")
+    old = time.time() - verify_reference.STALE_TMP_AGE_S - 60
+    os.utime(orphaned, (old, old))
+    in_flight = fake_repo / (verify_reference.MANIFEST_NAME + ".concurrent.tmp")
+    in_flight.write_text("{mid-write")
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert not orphaned.exists()
+    assert in_flight.exists()
+    manifest = json.loads((fake_repo / verify_reference.MANIFEST_NAME).read_text())
+    assert manifest["entry_count"] == 1
+
+
+def test_sidecar_replaced_by_non_regular_file_is_drift_exits_1(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A sidecar path that exists as anything but a regular file —
+    directory, FIFO, symlink loop — is a persistent state change, not a
+    read hiccup: rc 3's 're-run' advice could never succeed, so it must
+    classify as genuine drift with the 'not-a-regular-file' observation
+    (never pinnable) and the detail preserved. The FIFO case also
+    guards the output contract itself: classification must happen via a
+    non-blocking open + fstat of the open descriptor (race-free), since
+    a plain blocking open/read of a FIFO with no writer blocks
+    forever."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+
+    def replace_papers(create):
+        (fake_repo / "PAPERS.md").unlink()
+        create(fake_repo / "PAPERS.md")
+
+    cases = [
+        (lambda p: p.mkdir(), "d"),
+        (lambda p: os.mkfifo(p), "p"),
+        (lambda p: p.symlink_to(p.name), "loop"),  # ELOOP on stat
+    ]
+    for create, kind in cases:
+        replace_papers(create)
+        rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+        assert rc == verify_reference.EXIT_DRIFT, kind
+        assert result["transient_environment_failure"] is False, kind
+        assert result["observed"]["papers_md_sha256"] == "not-a-regular-file", kind
+        assert {d["fact"] for d in result["drift"]} == {"papers_md_sha256"}, kind
+        detail = result["sidecar_errors"]["papers_md_sha256"]
+        if kind == "loop":
+            assert detail.startswith("OSError"), detail
+        else:
+            assert detail.startswith("not a regular file: " + kind), detail
+        if (fake_repo / "PAPERS.md").is_dir():
+            (fake_repo / "PAPERS.md").rmdir()
+        else:
+            (fake_repo / "PAPERS.md").unlink()
+        (fake_repo / "PAPERS.md").write_text("# PAPERS\n")
+
+
+def test_dangling_symlink_sidecar_is_absent(tmp_path, fake_repo, monkeypatch, capsys):
+    """A dangling symlink in place of a sidecar has no content: it
+    observes as 'absent' (a persistent content fact → drift against a
+    pinned hash), not as unreadable/transient."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (fake_repo / "PAPERS.md").unlink()
+    (fake_repo / "PAPERS.md").symlink_to("does-not-exist")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["observed"]["papers_md_sha256"] == "absent"
+    assert result["transient_environment_failure"] is False
+
+
+def test_mount_stat_failure_degrades_without_affecting_exit_code(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A stat failure on an EXISTING mount path degrades to an error
+    field in the evidence (with class+message); the exit code is decided
+    by the scan and sidecar comparison alone."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+
+    # (a) the OSError arm of mount_stat itself
+    def broken_stat(self, **kwargs):
+        raise OSError(116, "Stale file handle")
+
+    with monkeypatch.context() as m:
+        m.setattr(pathlib.Path, "stat", broken_stat)
+        assert verify_reference.mount_stat(ref) == {
+            "error": "OSError: [Errno 116] Stale file handle"
+        }
+
+    # (b) a degraded mount_stat does not disturb an otherwise-clean verdict
+    monkeypatch.setattr(
+        verify_reference,
+        "mount_stat",
+        lambda path: {"error": "OSError: [Errno 116] Stale file handle"},
+    )
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_MATCH
+    assert result["mount_stat"] == {"error": "OSError: [Errno 116] Stale file handle"}
+
+
+def test_scan_count_and_manifest_agree(tmp_path):
+    """Invariant: bench.scan's count, build_manifest's length, and
+    write_manifest's recorded entry_count agree on the same tree —
+    the manifest is the evidence a SURVEY.md rewrite starts from, so it
+    must provably match the count that triggered it. Covers nested
+    dirs, empty dirs, file/dir/dangling symlinks, and the empty tree."""
+    t1 = tmp_path / "t1"
+    (t1 / "a" / "b" / "c").mkdir(parents=True)
+    (t1 / "a" / "f1").write_text("x")
+    (t1 / "a" / "b" / "f2").write_text("y")
+
+    t2 = tmp_path / "t2"
+    (t2 / "empty1").mkdir(parents=True)
+    (t2 / "empty2").mkdir()
+
+    t3 = tmp_path / "t3"
+    (t3 / "d").mkdir(parents=True)
+    (t3 / "d" / "f").write_text("z")
+    (t3 / "file_link").symlink_to("d/f")
+    (t3 / "dir_link").symlink_to("d")  # not followed: counts as ONE entry
+    (t3 / "dangling").symlink_to("does-not-exist")
+
+    t4 = tmp_path / "t4"
+    t4.mkdir()
+
+    for tree in (t1, t2, t3, t4):
+        repo = tmp_path / ("repo_" + tree.name)
+        repo.mkdir()
+        scanned = bench.scan(tree)["value"]
+        assert len(verify_reference.build_manifest(tree)) == scanned, tree
+        manifest_path = verify_reference.write_manifest(tree, repo)
+        written = json.loads(pathlib.Path(manifest_path).read_text())
+        assert written["entry_count"] == scanned, tree
+
+
+def test_uncommitted_round_artifacts_field(tmp_path, monkeypatch, capsys):
+    """Round-artifact hygiene is mechanical, not prose: untracked or
+    modified driver artifacts (BENCH_r*/MULTICHIP_r*/VERDICT/ADVICE)
+    are listed in the gate's JSON line; unrelated dirty files are not;
+    a clean tree reports []; a non-git repo dir reports null. The field
+    never affects the exit code."""
+    import subprocess
+
+    from conftest import make_fake_repo
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    repo = make_fake_repo(tmp_path)
+
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_MATCH
+    assert result["uncommitted_round_artifacts"] is None  # not a git repo
+
+    def git(*args):
+        subprocess.run(
+            [
+                "git",
+                "-C",
+                str(repo),
+                "-c",
+                "user.email=t@example.com",
+                "-c",
+                "user.name=t",
+                *args,
+            ],
+            check=True,
+            capture_output=True,
+        )
+
+    git("init", "-q")
+    (repo / "VERDICT.md").write_text("round-N verdict\n")
+    git("add", "-A")
+    git("commit", "-q", "-m", "baseline")
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_MATCH
+    assert result["uncommitted_round_artifacts"] == []
+
+    (repo / "BENCH_r09.json").write_text("{}\n")  # untracked artifact
+    # Space + non-ASCII: must come through verbatim (the -z parse), not
+    # as git's C-quoted form with literal quotes and escapes.
+    (repo / "BENCH_r11 ä.json").write_text("{}\n")
+    (repo / "MULTICHIP_r09.json").write_text("{}\n")  # untracked artifact
+    (repo / "VERDICT.md").write_text("changed\n")  # modified artifact
+    (repo / "unrelated.txt").write_text("x\n")  # dirty but not an artifact
+    # A fingerprinted sidecar that is untracked (content unchanged, so no
+    # drift) is a hygiene fact too — the round-4 SNIPPETS.md situation.
+    git("rm", "--cached", "-q", "PAPERS.md")
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_MATCH
+    assert result["uncommitted_round_artifacts"] == [
+        "BENCH_r09.json",
+        "BENCH_r11 ä.json",
+        "MULTICHIP_r09.json",
+        "PAPERS.md",
+        "VERDICT.md",
+    ]
+
+    git("add", "-A")
+    git("commit", "-q", "-m", "artifacts committed")
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert result["uncommitted_round_artifacts"] == []
 
 
 def test_e2e_real_repo_fingerprint_matches_live_mount(e2e):

@@ -13,12 +13,26 @@ the results against the committed fingerprint
 - mount stat facts (mode, link count, timestamps) — recorded as
   evidence only, NOT compared: the mount is recreated every round, so
   timestamps legitimately differ while content facts must not;
-- sha256 of the driver sidecars BASELINE.json and PAPERS.md, and the
-  presence/absence of SNIPPETS.md — retrieved public content appearing
-  mid-project is the most likely vector for accidentally "discovering"
-  capabilities the reference never had, so sidecar drift is surfaced
-  explicitly (it does NOT by itself change what there is to build:
-  only the mounted tree defines capabilities).
+- sha256 of the driver sidecars BASELINE.json, PAPERS.md and
+  SNIPPETS.md — retrieved public content appearing mid-project is the
+  most likely vector for accidentally "discovering" capabilities the
+  reference never had, so sidecar drift is surfaced explicitly (it
+  does NOT by itself change what there is to build: only the mounted
+  tree defines capabilities). Each sidecar observation is four-state:
+  a sha256 hex digest; "absent" (the file does not exist — a real
+  content fact, compared against the fingerprint); "not-a-regular-file"
+  (a directory in place of the sidecar — a persistent state change,
+  so genuine drift); or "unreadable" (any other OSError — a transient
+  read failure that must classify as rc 3, never as drift and never
+  as a match).
+
+The JSON line also carries `uncommitted_round_artifacts` — a
+best-effort `git status` over the driver-written files (BENCH_r*.json,
+MULTICHIP_r*.json, VERDICT.md, ADVICE.md, and the fingerprinted
+sidecars BASELINE.json/PAPERS.md/SNIPPETS.md), so the round-start rule
+"commit the previous round's artifacts first" is checked mechanically
+instead of relying on a session reading prose. Null when the repo dir
+is not a git work tree; never affects the exit code.
 
 Output: exactly ONE JSON line on stdout with the evidence and a `drift`
 list. Exit codes (each failure mode distinct, so exit-code-only
@@ -27,15 +41,22 @@ misread one as another):
 
 - 0  everything matches the fingerprint: reference still empty,
      sidecars unchanged; the non-graftable verdict stands.
-- 1  genuine drift: the reference tree is non-empty or the sidecars
-     changed. If the tree is non-empty, SURVEY.md is obsolete —
-     rewrite it from the real tree before writing any code.
+- 1  genuine drift: the reference tree is non-empty or a readable
+     sidecar's content changed (including a sidecar appearing,
+     disappearing, or being replaced by a directory). If the tree is
+     non-empty, SURVEY.md is obsolete —
+     rewrite it from the real tree before writing any code (see
+     SURVEY_REWRITE.md for the mandated procedure).
 - 2  could not gather evidence: fingerprint missing or corrupt
      (repo bug, fix the fingerprint).
 - 3  transient environment failure: the mount is absent, unreadable,
-     or went stale mid-walk. This is NOT evidence the reference
-     changed — there is no tree to re-survey; investigate the mount
-     and re-run.
+     or went stale mid-walk, or a sidecar exists but could not be
+     read. This is NOT evidence the surveyed state changed;
+     investigate the environment and re-run.
+- 4  the gate itself crashed (unhandled exception). Printed as a
+     one-line JSON error; a repo bug to fix, carrying no evidence
+     about the reference either way. Distinct from rc 1 so a crash
+     can never read as "genuine drift".
 
 When a non-empty tree is observed, a per-file manifest (relative path,
 type, size, sha256) is additionally written to
@@ -52,13 +73,17 @@ Paths are overridable for tests: GRAFT_REFERENCE_PATH (mount) and
 GRAFT_REPO_PATH (directory holding the fingerprint and sidecars).
 """
 
+import errno
 import hashlib
 import json
 import os
 import pathlib
+import re
 import stat as stat_module
+import subprocess
 import sys
 import tempfile
+import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
 import bench  # the accessibility check + guarded walk live in ONE place
@@ -66,24 +91,108 @@ import bench  # the accessibility check + guarded walk live in ONE place
 DEFAULT_REFERENCE = "/root/reference"
 FINGERPRINT_NAME = "reference_fingerprint.json"
 MANIFEST_NAME = "reference_manifest_observed.json"
-COMPARED_KEYS = (
-    "reference_entry_count",
-    "baseline_json_sha256",
-    "papers_md_sha256",
-    "snippets_md_present",
+# Sidecar fact name -> file the observation reads. The fact names double
+# as fingerprint keys; each value is a sha256 hex digest or "absent".
+SIDECAR_FILES = {
+    "baseline_json_sha256": "BASELINE.json",
+    "papers_md_sha256": "PAPERS.md",
+    "snippets_md_sha256": "SNIPPETS.md",
+}
+COMPARED_KEYS = ("reference_entry_count",) + tuple(SIDECAR_FILES)
+SIDECAR_ABSENT = "absent"
+SIDECAR_UNREADABLE = "unreadable"
+SIDECAR_NOT_A_FILE = "not-a-regular-file"
+# Orphaned manifest temp files older than this are swept; younger ones
+# may belong to a concurrent run mid-write and must be left alone.
+STALE_TMP_AGE_S = 3600
+_SHA256_HEX = re.compile(r"[0-9a-f]{64}")
+# Driver-written files the round-start rule says to commit before any
+# other work; uncommitted_round_artifacts() reports them mechanically.
+# Includes the fingerprinted sidecars: round 4 began with a driver-
+# populated SNIPPETS.md sitting untracked — exactly what this check
+# exists to surface. PROGRESS.jsonl is deliberately excluded: the
+# driver rewrites it mid-round, so it is expected to be dirty.
+ROUND_ARTIFACT_PATTERNS = (
+    "BENCH_r*.json",
+    "MULTICHIP_r*.json",
+    "VERDICT.md",
+    "ADVICE.md",
+    "BASELINE.json",
+    "PAPERS.md",
+    "SNIPPETS.md",
 )
 
 EXIT_MATCH = 0
 EXIT_DRIFT = 1
 EXIT_FINGERPRINT_CORRUPT = 2
 EXIT_TRANSIENT = 3
+EXIT_INTERNAL_ERROR = 4
 
 
-def sha256_of(path: pathlib.Path):
+def observe_sidecar(path: pathlib.Path):
+    """Four-state sidecar observation; returns (observation, error_detail).
+
+    - sha256 hex digest: present and readable (error_detail None);
+    - "absent": the file does not exist (including a dangling symlink).
+      A real content fact — a sidecar appearing or disappearing
+      relative to the fingerprint is genuine drift, exactly like a
+      content change;
+    - "not-a-regular-file": the path exists but is not a regular file
+      (directory, FIFO, socket, device, symlink loop). Also a real,
+      persistent state change — not a read hiccup a re-run could
+      clear — so it classifies as genuine drift, and it can never be
+      pinned in the fingerprint, so it always drifts. Detected
+      race-free by opening with O_NONBLOCK and fstat-ing the open
+      descriptor: a blocking open/read of a FIFO would hang the gate
+      forever, breaking both scripts' output contracts, and a
+      stat-then-open pair would leave a TOCTOU window for the same
+      hang;
+    - "unreadable": the file may exist but could not be examined or
+      read (any other OSError: permissions hiccup, flaky disk, stale
+      handle). The true state is unknown, so verify() classifies it
+      as transient (rc 3) — never as drift (rc 1), and never as a
+      match (rc 0). error_detail carries the class+message for the
+      evidence line.
+
+    Note Path.exists() is deliberately NOT used anywhere here: it
+    swallows OSErrors into False, which would make a present-but-
+    unreadable sidecar indistinguishable from an absent one.
+    """
     try:
-        return hashlib.sha256(path.read_bytes()).hexdigest()
-    except OSError:
-        return None
+        # O_NONBLOCK: opening a writer-less FIFO read-only succeeds
+        # immediately instead of blocking; regular files ignore the
+        # flag. The open itself follows symlinks (a symlink to a
+        # regular file is legitimate readable content; a loop raises
+        # ELOOP; a socket raises ENXIO — both persistent states).
+        fd = os.open(path, os.O_RDONLY | os.O_NONBLOCK)
+    except FileNotFoundError:
+        return SIDECAR_ABSENT, None
+    except IsADirectoryError as exc:
+        return SIDECAR_NOT_A_FILE, bench.exc_detail(exc)
+    except OSError as exc:
+        if exc.errno in (errno.ELOOP, errno.ENXIO):
+            return SIDECAR_NOT_A_FILE, bench.exc_detail(exc)
+        return SIDECAR_UNREADABLE, bench.exc_detail(exc)
+    try:
+        # fstat on the OPEN descriptor, so the type check and the read
+        # refer to the same filesystem object — no stat-to-open race.
+        st = os.fstat(fd)
+        if not stat_module.S_ISREG(st.st_mode):
+            return (
+                SIDECAR_NOT_A_FILE,
+                "not a regular file: " + stat_module.filemode(st.st_mode),
+            )
+        digest = hashlib.sha256()
+        while True:
+            chunk = os.read(fd, 1 << 20)
+            if not chunk:
+                break
+            digest.update(chunk)
+        return digest.hexdigest(), None
+    except OSError as exc:
+        return SIDECAR_UNREADABLE, bench.exc_detail(exc)
+    finally:
+        os.close(fd)
 
 
 def count_entries(reference: pathlib.Path, scan_result: dict = None):
@@ -118,16 +227,70 @@ def mount_stat(reference: pathlib.Path):
             "mtime": st.st_mtime,
         }
     except OSError as exc:
-        return {"error": exc.__class__.__name__}
+        return {"error": bench.exc_detail(exc)}
 
 
-def gather(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None) -> dict:
-    return {
-        "reference_entry_count": count_entries(reference, scan_result),
-        "baseline_json_sha256": sha256_of(repo / "BASELINE.json"),
-        "papers_md_sha256": sha256_of(repo / "PAPERS.md"),
-        "snippets_md_present": (repo / "SNIPPETS.md").exists(),
+def gather(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None):
+    """Observed facts plus a {fact: error_detail} map for unreadable
+    sidecars (empty in the normal case)."""
+    observed = {"reference_entry_count": count_entries(reference, scan_result)}
+    sidecar_errors = {}
+    for key, filename in SIDECAR_FILES.items():
+        observed[key], error_detail = observe_sidecar(repo / filename)
+        if error_detail is not None:
+            sidecar_errors[key] = error_detail
+    return observed, sidecar_errors
+
+
+def uncommitted_round_artifacts(repo: pathlib.Path):
+    """Best-effort: driver round artifacts not committed in `repo`'s git
+    work tree (untracked or modified), sorted. None when undeterminable
+    (not a git repo, git missing/failed) — mechanism for the round-start
+    rule "commit the previous round's artifacts first", which recurred
+    as a failure in rounds 1-2 while it was prose-only. Never raises and
+    never affects the exit code: hygiene reporting must not block the
+    drift verdict.
+    """
+    # Strip inherited GIT_* overrides (GIT_DIR/GIT_WORK_TREE would point
+    # `git -C` at a different repo; GIT_INDEX_FILE — exported inside git
+    # hooks — would diff against an in-flight index). The deliberate
+    # exception is GIT_CEILING_DIRECTORIES, which only bounds upward
+    # repo discovery and is how tests pin the "not a git repo" state.
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("GIT_") or k == "GIT_CEILING_DIRECTORIES"
     }
+    try:
+        proc = subprocess.run(
+            [
+                "git",
+                "-C",
+                str(repo),
+                "status",
+                "--porcelain",
+                "-z",
+                "--untracked-files=all",
+                "--no-renames",
+                "--",
+                *ROUND_ARTIFACT_PATTERNS,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=10,
+            env=env,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+    # Porcelain v1 -z: NUL-separated "XY path" entries, paths verbatim
+    # (no C-quoting of spaces/non-ASCII, which line-based parsing would
+    # mangle). The pathspec above already restricts output to the
+    # artifact patterns.
+    return sorted(
+        {entry[3:] for entry in proc.stdout.split("\0") if len(entry) > 3}
+    )
 
 
 def _manifest_entry(path: pathlib.Path, root: pathlib.Path) -> dict:
@@ -135,7 +298,7 @@ def _manifest_entry(path: pathlib.Path, root: pathlib.Path) -> dict:
     try:
         st = path.lstat()
     except OSError as exc:
-        return {"path": rel, "type": "error", "error": exc.__class__.__name__}
+        return {"path": rel, "type": "error", "error": bench.exc_detail(exc)}
     if stat_module.S_ISLNK(st.st_mode):
         entry = {"path": rel, "type": "symlink", "size": st.st_size, "sha256": None}
         try:
@@ -143,7 +306,7 @@ def _manifest_entry(path: pathlib.Path, root: pathlib.Path) -> dict:
         except OSError as exc:
             # Unreadable must be visibly unreadable, same as the file branch.
             entry["target"] = None
-            entry["error"] = exc.__class__.__name__
+            entry["error"] = bench.exc_detail(exc)
         return entry
     if stat_module.S_ISDIR(st.st_mode):
         return {"path": rel, "type": "dir", "size": None, "sha256": None}
@@ -157,7 +320,7 @@ def _manifest_entry(path: pathlib.Path, root: pathlib.Path) -> dict:
             "type": "file",
             "size": st.st_size,
             "sha256": None,
-            "error": exc.__class__.__name__,
+            "error": bench.exc_detail(exc),
         }
     return {"path": rel, "type": "file", "size": st.st_size, "sha256": digest}
 
@@ -190,6 +353,20 @@ def write_manifest(reference: pathlib.Path, repo: pathlib.Path) -> str:
     evidence file.
     """
     manifest_path = repo / MANIFEST_NAME
+    # Sweep temp files orphaned by a crash between mkstemp and os.replace
+    # in an earlier run — nothing else ever deletes them. Age-gated so a
+    # CONCURRENT run's in-flight temp file (bench and verify_reference
+    # can race in the same round) is never unlinked between its
+    # write_text and os.replace — only genuinely abandoned ones.
+    try:
+        for stale in repo.glob(MANIFEST_NAME + ".*.tmp"):
+            try:
+                if time.time() - stale.stat().st_mtime > STALE_TMP_AGE_S:
+                    stale.unlink()
+            except OSError:
+                pass
+    except OSError:
+        pass
     entries = build_manifest(reference)
     payload = {
         "comment": (
@@ -247,30 +424,44 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
             raise ValueError("reference_entry_count must be a non-negative int")
         # Same defense for the sidecar facts: a missing/null/mistyped key
         # is a corrupt fingerprint (rc 2, fix the repo), not "the sidecars
-        # drifted" (rc 1, a verdict-affecting workflow).
-        for key in ("baseline_json_sha256", "papers_md_sha256"):
-            if not isinstance(fingerprint.get(key), str):
-                raise ValueError(f"{key} must be a string")
-        if not isinstance(fingerprint.get("snippets_md_present"), bool):
-            raise ValueError("snippets_md_present must be a bool")
+        # drifted" (rc 1, a verdict-affecting workflow). Values must be a
+        # sha256 hex digest or the literal "absent" — in particular the
+        # transient "unreadable" sentinel must never be pinned, or every
+        # future read failure would "match" with rc 0.
+        for key in SIDECAR_FILES:
+            value = fingerprint.get(key)
+            if not isinstance(value, str) or not (
+                value == SIDECAR_ABSENT or _SHA256_HEX.fullmatch(value)
+            ):
+                raise ValueError(f"{key} must be a sha256 hex digest or 'absent'")
     except (OSError, ValueError):
         return (
             {
                 "check": "reference_verification",
                 "error": "fingerprint_missing_or_corrupt",
                 "fingerprint_path": str(fingerprint_path),
+                "note": (
+                    "the committed fingerprint is missing or corrupt — a repo "
+                    "bug to fix; carries no evidence about the reference"
+                ),
             },
             EXIT_FINGERPRINT_CORRUPT,
         )
 
-    observed = gather(reference, repo, scan_result)
+    observed, sidecar_errors = gather(reference, repo, scan_result)
     drift = [
         {"fact": key, "fingerprint": fingerprint.get(key), "observed": observed[key]}
         for key in COMPARED_KEYS
         if observed[key] != fingerprint.get(key)
     ]
     count = observed["reference_entry_count"]
-    transient = count in ("mount_missing_or_unreadable", "scan_error")
+    mount_transient = count in ("mount_missing_or_unreadable", "scan_error")
+    unreadable_sidecars = sorted(
+        SIDECAR_FILES[key]
+        for key in SIDECAR_FILES
+        if observed[key] == SIDECAR_UNREADABLE
+    )
+    transient = mount_transient or bool(unreadable_sidecars)
 
     manifest = None
     manifest_error = None
@@ -278,9 +469,21 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
         try:
             manifest = write_manifest(reference, repo)
         except OSError as exc:
-            manifest_error = exc.__class__.__name__
+            manifest_error = bench.exc_detail(exc)
 
-    non_count_drift = [d for d in drift if d["fact"] != "reference_entry_count"]
+    # Transient observations (unscannable mount, unreadable sidecar)
+    # always mismatch the fingerprint — the fingerprint never stores a
+    # transient sentinel — so they appear in `drift` as evidence, but
+    # they are not *genuine* drift: the true state is unknown, not
+    # known-changed. Only genuine drift may produce rc 1.
+    genuine_drift = [
+        d
+        for d in drift
+        if not (
+            (d["fact"] == "reference_entry_count" and mount_transient)
+            or observed[d["fact"]] == SIDECAR_UNREADABLE
+        )
+    ]
 
     if not drift:
         exit_code = EXIT_MATCH
@@ -296,23 +499,33 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
                 "applies — build against the surveyed tree."
                 + (" See the manifest." if manifest is not None else "")
             )
-    elif transient and not non_count_drift:
+    elif not genuine_drift:
         exit_code = EXIT_TRANSIENT
+        failures = []
+        if mount_transient:
+            failures.append(
+                "the mount could not be scanned (absent, unreadable, or "
+                "going stale mid-walk)"
+            )
+        if unreadable_sidecars:
+            failures.append(
+                "sidecar(s) could not be read: " + ", ".join(unreadable_sidecars)
+            )
         note = (
-            "TRANSIENT ENVIRONMENT FAILURE: the mount could not be scanned "
-            "(absent, unreadable, or going stale mid-walk). This is NOT "
-            "evidence the reference changed — there is no tree to re-survey. "
-            "Investigate the mount / re-run; do not touch SURVEY.md."
+            "TRANSIENT ENVIRONMENT FAILURE: "
+            + "; ".join(failures)
+            + ". This is NOT evidence the surveyed state changed. "
+            "Investigate the environment / re-run; do not touch SURVEY.md."
         )
     else:
-        # Sidecar drift is genuine drift even when the mount is also
-        # unscannable this run — rc 3 must never mask it from
-        # exit-code-only consumers.
+        # Genuine drift outranks any concurrent transient failure —
+        # rc 3 must never mask confirmed drift from exit-code-only
+        # consumers.
         exit_code = EXIT_DRIFT
         note = (
             "DRIFT: the surveyed state changed. If the reference tree is "
             "non-empty, SURVEY.md is obsolete — rewrite it from the real tree "
-            "before writing any code"
+            "before writing any code (procedure: SURVEY_REWRITE.md)"
             + (
                 " (see the manifest for the observed entries)"
                 if manifest is not None
@@ -321,11 +534,17 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
             + ". Sidecar-only drift (PAPERS/SNIPPETS) does not add "
             "capabilities: only the mounted tree defines what to build."
         )
-        if transient:
+        if mount_transient:
             note += (
                 " NOTE: the mount itself could not be scanned this run "
                 "(transient environment failure), so only the sidecar drift "
                 "is confirmed; re-run once the mount is back."
+            )
+        if unreadable_sidecars:
+            note += (
+                " NOTE: unreadable this run (transient, not confirmed drift): "
+                + ", ".join(unreadable_sidecars)
+                + "."
             )
 
     result = {
@@ -338,21 +557,46 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
         "observed": observed,
         "mount_stat": mount_stat(reference),
         "manifest": manifest,
+        "uncommitted_round_artifacts": uncommitted_round_artifacts(repo),
         "note": note,
     }
+    if sidecar_errors:
+        result["sidecar_errors"] = sidecar_errors
     if manifest_error is not None:
         result["manifest_error"] = manifest_error
     return result, exit_code
 
 
 def main() -> int:
-    reference = pathlib.Path(os.environ.get("GRAFT_REFERENCE_PATH", DEFAULT_REFERENCE))
-    repo = pathlib.Path(
-        os.environ.get("GRAFT_REPO_PATH", pathlib.Path(__file__).resolve().parent)
-    )
-    result, exit_code = verify(reference, repo)
-    print(json.dumps(result))
-    return exit_code
+    try:
+        reference = pathlib.Path(
+            os.environ.get("GRAFT_REFERENCE_PATH", DEFAULT_REFERENCE)
+        )
+        repo = pathlib.Path(
+            os.environ.get("GRAFT_REPO_PATH", pathlib.Path(__file__).resolve().parent)
+        )
+        result, exit_code = verify(reference, repo)
+        print(json.dumps(result))
+        return exit_code
+    except Exception as exc:  # noqa: BLE001 — rc must stay meaningful
+        # Without this, an unhandled exception exits with Python's
+        # default status 1 — colliding with EXIT_DRIFT, so an
+        # exit-code-only consumer would read a gate crash as "genuine
+        # drift". A crash carries no evidence about the reference.
+        print(
+            json.dumps(
+                {
+                    "check": "reference_verification",
+                    "error": "internal_error",
+                    "detail": bench.exc_detail(exc),
+                    "note": (
+                        "the gate itself crashed — a repo bug, not evidence "
+                        "about the reference; fix the gate and re-run"
+                    ),
+                }
+            )
+        )
+        return EXIT_INTERNAL_ERROR
 
 
 if __name__ == "__main__":
