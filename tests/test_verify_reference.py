@@ -140,20 +140,20 @@ def test_unreadable_file_is_marked_in_manifest(tmp_path, fake_repo, monkeypatch,
     (ref / "ok.txt").write_text("fine\n")
     (ref / "broken.txt").write_text("secret\n")
     (ref / "badlink").symlink_to("ok.txt")
-    real_read_bytes = pathlib.Path.read_bytes
+    real_os_open = os.open
     real_readlink = os.readlink
 
-    def flaky_read_bytes(self):
-        if self.name == "broken.txt":
+    def flaky_os_open(target, *args, **kwargs):
+        if pathlib.Path(target).name == "broken.txt":
             raise PermissionError("no read access")
-        return real_read_bytes(self)
+        return real_os_open(target, *args, **kwargs)
 
     def flaky_readlink(path, *args, **kwargs):
         if pathlib.Path(path).name == "badlink":
             raise OSError("stale handle")
         return real_readlink(path, *args, **kwargs)
 
-    monkeypatch.setattr(pathlib.Path, "read_bytes", flaky_read_bytes)
+    monkeypatch.setattr(os, "open", flaky_os_open)
     monkeypatch.setattr(os, "readlink", flaky_readlink)
     rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
     assert rc == verify_reference.EXIT_DRIFT
@@ -168,6 +168,30 @@ def test_unreadable_file_is_marked_in_manifest(tmp_path, fake_repo, monkeypatch,
     assert by_path["badlink"]["error"] == "OSError: stale handle"
     assert by_path["ok.txt"]["sha256"] == hashlib.sha256(b"fine\n").hexdigest()
     assert "error" not in by_path["ok.txt"]
+
+
+def test_fifo_in_reference_tree_cannot_hang_the_manifest(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A FIFO (or other special file) inside an observed non-empty tree
+    is recorded as type 'special' WITHOUT being opened: a blocking read
+    of a writer-less FIFO would hang the gate forever and break the
+    one-line output contract — the same hazard the sidecar reads guard
+    against. On failure this test hangs rather than asserts, which is
+    the loudest possible signal."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / "normal.txt").write_text("data\n")
+    os.mkfifo(ref / "pipe")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    manifest = json.loads((fake_repo / verify_reference.MANIFEST_NAME).read_text())
+    by_path = {e["path"]: e for e in manifest["entries"]}
+    assert by_path["pipe"]["type"] == "special"
+    assert by_path["pipe"]["sha256"] is None
+    assert by_path["pipe"]["mode"].startswith("p")
+    assert by_path["normal.txt"]["sha256"] == hashlib.sha256(b"data\n").hexdigest()
+    assert manifest["entry_count"] == 2
 
 
 def test_matching_nonempty_fingerprint_retires_the_emptiness_note(
@@ -590,6 +614,7 @@ def test_scan_count_and_manifest_agree(tmp_path):
     (t3 / "file_link").symlink_to("d/f")
     (t3 / "dir_link").symlink_to("d")  # not followed: counts as ONE entry
     (t3 / "dangling").symlink_to("does-not-exist")
+    os.mkfifo(t3 / "pipe")  # special file: counted and recorded, never read
 
     t4 = tmp_path / "t4"
     t4.mkdir()

@@ -58,11 +58,14 @@ misread one as another):
      about the reference either way. Distinct from rc 1 so a crash
      can never read as "genuine drift".
 
-When a non-empty tree is observed, a per-file manifest (relative path,
-type, size, sha256) is additionally written to
-`reference_manifest_observed.json` in the repo directory — evidence to
-bootstrap the mandated SURVEY.md rewrite, so the obsolescence path
-starts from facts instead of a blank page. stdout stays one JSON line.
+When a non-empty tree is observed, a per-entry manifest is additionally
+written to `reference_manifest_observed.json` in the repo directory —
+relative path, type, size, sha256 per entry; types are file / dir /
+symlink (with target) / special (FIFO/socket/device, carrying a `mode`
+field and never opened, so they cannot hang the walk) / error. This is
+evidence to bootstrap the mandated SURVEY.md rewrite, so the
+obsolescence path starts from facts instead of a blank page. stdout
+stays one JSON line.
 
 The core comparison lives in `verify(reference, repo)` so bench.py can
 embed the same evidence in the driver's mandatory bench line every
@@ -129,6 +132,16 @@ EXIT_TRANSIENT = 3
 EXIT_INTERNAL_ERROR = 4
 
 
+def _sha256_of_fd(fd: int) -> str:
+    digest = hashlib.sha256()
+    while True:
+        chunk = os.read(fd, 1 << 20)
+        if not chunk:
+            break
+        digest.update(chunk)
+    return digest.hexdigest()
+
+
 def observe_sidecar(path: pathlib.Path):
     """Four-state sidecar observation; returns (observation, error_detail).
 
@@ -182,13 +195,7 @@ def observe_sidecar(path: pathlib.Path):
                 SIDECAR_NOT_A_FILE,
                 "not a regular file: " + stat_module.filemode(st.st_mode),
             )
-        digest = hashlib.sha256()
-        while True:
-            chunk = os.read(fd, 1 << 20)
-            if not chunk:
-                break
-            digest.update(chunk)
-        return digest.hexdigest(), None
+        return _sha256_of_fd(fd), None
     except OSError as exc:
         return SIDECAR_UNREADABLE, bench.exc_detail(exc)
     finally:
@@ -293,6 +300,31 @@ def uncommitted_round_artifacts(repo: pathlib.Path):
     )
 
 
+def _special_entry(rel: str, st: os.stat_result) -> dict:
+    """Manifest entry for a FIFO/socket/device: recorded, never opened —
+    a blocking read of a writer-less FIFO would hang the gate and break
+    the one-line output contract (same hazard observe_sidecar guards)."""
+    return {
+        "path": rel,
+        "type": "special",
+        "size": st.st_size,
+        "sha256": None,
+        "mode": stat_module.filemode(st.st_mode),
+    }
+
+
+def _unreadable_file_entry(rel: str, st: os.stat_result, exc: OSError) -> dict:
+    """An unreadable file must be visibly unreadable in the evidence,
+    not shaped like a dir/symlink's benign sha256:null."""
+    return {
+        "path": rel,
+        "type": "file",
+        "size": st.st_size,
+        "sha256": None,
+        "error": bench.exc_detail(exc),
+    }
+
+
 def _manifest_entry(path: pathlib.Path, root: pathlib.Path) -> dict:
     rel = path.relative_to(root).as_posix()
     try:
@@ -310,19 +342,31 @@ def _manifest_entry(path: pathlib.Path, root: pathlib.Path) -> dict:
         return entry
     if stat_module.S_ISDIR(st.st_mode):
         return {"path": rel, "type": "dir", "size": None, "sha256": None}
+    if not stat_module.S_ISREG(st.st_mode):
+        return _special_entry(rel, st)
     try:
-        digest = hashlib.sha256(path.read_bytes()).hexdigest()
+        # Same race-free pattern as observe_sidecar: O_NONBLOCK open,
+        # then fstat the descriptor so the type check and the read refer
+        # to the same object even if the entry changes under us.
+        # O_NOFOLLOW because lstat classified this path as a regular
+        # file (symlinks got their own branch above): an entry swapped
+        # for a symlink mid-walk must surface as an error, not silently
+        # hash the link's target under type "file".
+        fd = os.open(path, os.O_RDONLY | os.O_NONBLOCK | os.O_NOFOLLOW)
     except OSError as exc:
-        # An unreadable file must be visibly unreadable in the evidence,
-        # not shaped like a dir/symlink's benign sha256:null.
-        return {
-            "path": rel,
-            "type": "file",
-            "size": st.st_size,
-            "sha256": None,
-            "error": bench.exc_detail(exc),
-        }
-    return {"path": rel, "type": "file", "size": st.st_size, "sha256": digest}
+        return _unreadable_file_entry(rel, st, exc)
+    try:
+        fst = os.fstat(fd)
+        if not stat_module.S_ISREG(fst.st_mode):
+            return _special_entry(rel, fst)
+        digest = _sha256_of_fd(fd)
+    except OSError as exc:
+        return _unreadable_file_entry(rel, st, exc)
+    finally:
+        os.close(fd)
+    # Size from the SAME fstat that the digest came from, so the entry's
+    # size and sha256 can never describe two different objects.
+    return {"path": rel, "type": "file", "size": fst.st_size, "sha256": digest}
 
 
 def build_manifest(reference: pathlib.Path) -> list:
