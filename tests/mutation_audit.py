@@ -1,0 +1,252 @@
+"""Mutation audit: prove the test suite polices the honesty machinery.
+
+Every claim this repo makes — "an unreadable sidecar can never read as
+drift", "a gate crash can never read as rc 1", "bench can never report
+a half-scanned tree as empty" — is enforced only by tests/. This script
+checks that enforcement is real: it copies the runtime surface to a
+temp directory, introduces one targeted bug at a time (each the exact
+failure its property forbids), runs the suite against the mutated copy,
+and requires every mutant to be KILLED (suite goes red). A SURVIVED
+mutant means a documented honesty property is no longer test-enforced —
+the one way this repo can silently rot.
+
+Not a test itself (deliberately not named test_*): ~10 pytest
+subprocess runs cost ~40s wall-clock on this 1-CPU image, too slow for
+the regular suite the SKILL.md says to keep fast. Run on demand:
+
+    python tests/mutation_audit.py            # rc 0 iff all mutants killed
+
+What keeps THIS file from rotting instead: tests/test_mutation_audit.py
+(in the regular suite, milliseconds) asserts every mutation's `old`
+pattern still matches the live source, so a refactor that invalidates a
+mutation turns the suite red immediately rather than letting the audit
+degrade into a no-op.
+
+The audit run excludes test_mutation_audit.py from the mutated copy —
+by construction it fails under ANY source mutation (the pattern no
+longer matches), which would "kill" every mutant for free and void the
+audit. Exclusion is what makes a KILLED verdict meaningful.
+
+Output: one JSON summary line on stdout (per-mutant progress on
+stderr). Exit codes follow the repo's crash-vs-verdict discipline (a
+crash must never collide with a measured verdict, same as the gate's
+rc 4): 0 = every mutant killed; 1 = at least one SURVIVED (a measured
+verdict); 2 = the unmutated copy's suite was already red (nothing
+measurable); 3 = the audit itself crashed (timeout, copy failure —
+JSON error line, no verdict either way).
+"""
+
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# The runtime surface plus everything the suite needs to run. .git is
+# deliberately not copied: the hygiene tests build their own temp git
+# repos, and the copy must not look like a work tree.
+COPIED = (
+    "bench.py",
+    "verify_reference.py",
+    "reference_fingerprint.json",
+    "BASELINE.json",
+    "PAPERS.md",
+    "SNIPPETS.md",
+    "tests",
+)
+
+# Each mutation is the EXACT misbehavior a documented property forbids,
+# expressed as a unique literal substring of the live source (uniqueness
+# and presence are enforced by tests/test_mutation_audit.py). Fields:
+# (name, relative file, old, new, the property a survivor would break).
+MUTATIONS = (
+    (
+        "unreadable-sidecar-reads-as-absent",
+        "verify_reference.py",
+        '        return SIDECAR_UNREADABLE, bench.exc_detail(exc)\n    try:',
+        '        return SIDECAR_ABSENT, None\n    try:',
+        "a read hiccup must classify as transient, never as the content fact 'absent'",
+    ),
+    (
+        "unreadable-sidecar-counts-as-genuine-drift",
+        "verify_reference.py",
+        'or observed[d["fact"]] == SIDECAR_UNREADABLE',
+        'or False',
+        "an unreadable sidecar must never escalate rc 3 to rc 1 (false drift)",
+    ),
+    (
+        "transient-exits-as-drift",
+        "verify_reference.py",
+        '        exit_code = EXIT_TRANSIENT',
+        '        exit_code = EXIT_DRIFT',
+        "rc 3 and rc 1 must be distinct for exit-code-only consumers",
+    ),
+    (
+        "half-scanned-tree-reports-empty",
+        "bench.py",
+        '    except OSError:\n        return {\n            "metric": "reference_scan_error",\n            "value": -1,',
+        '    except OSError:\n        return {\n            "metric": "non_graftable_reference_is_empty",\n            "value": 0,',
+        "a mid-walk OSError must never report as an authoritative empty tree",
+    ),
+    (
+        "manifest-loses-file-hashes",
+        "verify_reference.py",
+        'return {"path": rel, "type": "file", "size": fst.st_size, "sha256": digest}',
+        'return {"path": rel, "type": "file", "size": fst.st_size, "sha256": None}',
+        "the remount manifest must carry per-file sha256 (SURVEY rewrite evidence)",
+    ),
+    (
+        "hygiene-check-always-clean",
+        "verify_reference.py",
+        '    return sorted(\n        {entry[3:] for entry in proc.stdout.split("\\0") if len(entry) > 3}\n    )',
+        '    return []',
+        "uncommitted round artifacts must be reported, not silently dropped",
+    ),
+    (
+        "gate-crash-exits-1",
+        "verify_reference.py",
+        '        return EXIT_INTERNAL_ERROR',
+        '        return 1',
+        "a gate crash (rc 4) must never collide with genuine drift (rc 1)",
+    ),
+    (
+        "fingerprint-accepts-non-int-count",
+        "verify_reference.py",
+        '            not isinstance(fingerprint_count, int)\n'
+        '            or isinstance(fingerprint_count, bool)\n'
+        '            or fingerprint_count < 0',
+        '            False',
+        "a corrupt fingerprint count must exit rc 2, not validate future transients",
+    ),
+    (
+        "match-note-endorses-stale-emptiness",
+        "verify_reference.py",
+        '        if count == 0:',
+        '        if isinstance(count, int):',
+        "an rc-0 match on a re-pinned NON-EMPTY tree must not claim emptiness",
+    ),
+    (
+        "bench-breaks-one-line-contract",
+        "bench.py",
+        '    print(json.dumps(result))\n    return 0',
+        '    print(json.dumps(result))\n    print("extra")\n    return 0',
+        "bench must print exactly one JSON line (driver contract)",
+    ),
+)
+
+
+def make_copy(dest: pathlib.Path) -> None:
+    for name in COPIED:
+        src = REPO / name
+        if src.is_dir():
+            shutil.copytree(
+                src, dest / name, ignore=shutil.ignore_patterns("__pycache__")
+            )
+        else:
+            shutil.copy2(src, dest / name)
+
+
+def run_suite(copy: pathlib.Path) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "tests/",
+            "-x",
+            "-q",
+            "--no-header",
+            "-p",
+            "no:cacheprovider",
+            # See module docstring: the pattern-consistency test fails
+            # under ANY mutation and must not count as a kill.
+            "--ignore",
+            str(copy / "tests" / "test_mutation_audit.py"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=copy,
+        timeout=600,
+    )
+
+
+def main() -> int:
+    try:
+        return _run_audit()
+    except Exception as exc:  # noqa: BLE001 — rc must stay a verdict
+        # Without this, a hung suite subprocess (TimeoutExpired) or a
+        # copy failure would exit with Python's default rc 1 — reading
+        # to an rc-only consumer as "a mutant survived" when nothing
+        # was measured. Same collision class the gate's rc 4 exists
+        # to prevent.
+        print(
+            json.dumps(
+                {
+                    "check": "mutation_audit",
+                    "error": "audit_crashed",
+                    "detail": f"{exc.__class__.__name__}: {exc}"[:200],
+                }
+            )
+        )
+        return 3
+
+
+def _run_audit() -> int:
+    survived = []
+    root = pathlib.Path(tempfile.mkdtemp(prefix="graft-mutation-audit-"))
+    copy = root / "repo"
+    copy.mkdir()
+    try:
+        make_copy(copy)
+        # Sanity: the unmutated copy must be green, or every verdict
+        # below is noise.
+        clean = run_suite(copy)
+        if clean.returncode != 0:
+            print(
+                json.dumps(
+                    {
+                        "check": "mutation_audit",
+                        "error": "clean_copy_suite_red",
+                        "detail": clean.stdout.strip().splitlines()[-1:],
+                    }
+                )
+            )
+            return 2
+        for name, relpath, old, new, property_broken in MUTATIONS:
+            target = copy / relpath
+            pristine = target.read_text()
+            if old not in pristine:
+                # test_mutation_audit.py should have caught this first.
+                survived.append({"name": name, "reason": "pattern_missing"})
+                print(f"STALE    {name}: pattern missing", file=sys.stderr)
+                continue
+            target.write_text(pristine.replace(old, new, 1))
+            try:
+                proc = run_suite(copy)
+            finally:
+                target.write_text(pristine)
+            if proc.returncode == 0:
+                survived.append({"name": name, "property": property_broken})
+                print(f"SURVIVED {name}", file=sys.stderr)
+            else:
+                print(f"KILLED   {name}", file=sys.stderr)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    print(
+        json.dumps(
+            {
+                "check": "mutation_audit",
+                "total": len(MUTATIONS),
+                "killed": len(MUTATIONS) - len(survived),
+                "survived": survived,
+            }
+        )
+    )
+    return 0 if not survived else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

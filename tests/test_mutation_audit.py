@@ -1,0 +1,40 @@
+"""Keep tests/mutation_audit.py from rotting.
+
+The audit's value rests on each mutation's `old` pattern matching the
+live source: a refactor that renames a constant or reflows a line would
+otherwise silently turn that mutation into a no-op and the audit into a
+false "all killed". These checks run in the regular suite (milliseconds,
+no subprocesses) so pattern drift turns the suite red in the same
+commit that caused it.
+
+Deliberately NOT copied into the audit's mutated runs (mutation_audit
+passes --ignore for this file): under any source mutation the pattern
+assertion below fails by construction, which would count as a free
+"kill" for every mutant and void the audit. See the audit's module
+docstring.
+"""
+
+import mutation_audit
+
+
+def test_every_mutation_pattern_matches_live_source_exactly_once():
+    for name, relpath, old, new, _property in mutation_audit.MUTATIONS:
+        source = (mutation_audit.REPO / relpath).read_text()
+        occurrences = source.count(old)
+        assert occurrences == 1, (
+            f"mutation {name!r}: pattern occurs {occurrences}x in {relpath} "
+            "(must be exactly 1 — update tests/mutation_audit.py in the "
+            "same commit as the source refactor)"
+        )
+        assert old != new, f"mutation {name!r} is a no-op"
+
+
+def test_mutations_cover_both_runtime_surfaces():
+    files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
+    assert files == {"bench.py", "verify_reference.py"}
+
+
+def test_copied_set_exists_and_excludes_git():
+    for name in mutation_audit.COPIED:
+        assert (mutation_audit.REPO / name).exists(), name
+    assert ".git" not in mutation_audit.COPIED
