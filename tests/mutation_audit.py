@@ -44,6 +44,8 @@ import sys
 import tempfile
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+import bench  # the LIVE repo's error-detail formatting, shared repo-wide
 
 # The runtime surface plus everything the suite needs to run. .git is
 # deliberately not copied: the hygiene tests build their own temp git
@@ -187,7 +189,7 @@ def main() -> int:
                 {
                     "check": "mutation_audit",
                     "error": "audit_crashed",
-                    "detail": f"{exc.__class__.__name__}: {exc}"[:200],
+                    "detail": bench.exc_detail(exc),
                 }
             )
         )
@@ -220,7 +222,13 @@ def _run_audit() -> int:
             pristine = target.read_text()
             if old not in pristine:
                 # test_mutation_audit.py should have caught this first.
-                survived.append({"name": name, "reason": "pattern_missing"})
+                survived.append(
+                    {
+                        "name": name,
+                        "reason": "pattern_missing",
+                        "property": property_broken,
+                    }
+                )
                 print(f"STALE    {name}: pattern missing", file=sys.stderr)
                 continue
             target.write_text(pristine.replace(old, new, 1))

@@ -14,6 +14,10 @@ assertion below fails by construction, which would count as a free
 docstring.
 """
 
+import json
+import shutil
+import subprocess
+
 import mutation_audit
 
 
@@ -38,3 +42,134 @@ def test_copied_set_exists_and_excludes_git():
     for name in mutation_audit.COPIED:
         assert (mutation_audit.REPO / name).exists(), name
     assert ".git" not in mutation_audit.COPIED
+
+
+# --- Verdict plumbing, in-process (run_suite/make_copy faked so no ---
+# --- pytest subprocesses run; the real end-to-end audit is on-demand) ---
+
+
+def _FakeProc(returncode, stdout=""):
+    """Type-faithful stand-in for run_suite's return value."""
+    return subprocess.CompletedProcess(args=[], returncode=returncode, stdout=stdout)
+
+
+def _fake_sources_only(dest):
+    """Stand-in for make_copy: just the two mutable sources, so the
+    mutation patterns resolve without dragging the whole tree along."""
+    for name in ("bench.py", "verify_reference.py"):
+        shutil.copy2(mutation_audit.REPO / name, dest / name)
+
+
+def _audit_json(capsys):
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+def test_all_mutants_killed_exits_0(monkeypatch, capsys):
+    calls = []
+
+    def fake_run_suite(copy):
+        calls.append(copy)
+        # First call is the clean-copy sanity check; every mutated run red.
+        return _FakeProc(0 if len(calls) == 1 else 1)
+
+    monkeypatch.setattr(mutation_audit, "make_copy", _fake_sources_only)
+    monkeypatch.setattr(mutation_audit, "run_suite", fake_run_suite)
+    assert mutation_audit.main() == 0
+    summary = _audit_json(capsys)
+    assert summary["killed"] == summary["total"] == len(mutation_audit.MUTATIONS)
+    assert summary["survived"] == []
+    assert len(calls) == 1 + len(mutation_audit.MUTATIONS)
+
+
+def test_surviving_mutant_exits_1_and_is_named(monkeypatch, capsys):
+    survivor = mutation_audit.MUTATIONS[2][0]
+    calls = []
+
+    def fake_run_suite(copy):
+        calls.append(copy)
+        # Clean check green; mutant #3's run also green = SURVIVED.
+        return _FakeProc(0 if len(calls) in (1, 4) else 1)
+
+    monkeypatch.setattr(mutation_audit, "make_copy", _fake_sources_only)
+    monkeypatch.setattr(mutation_audit, "run_suite", fake_run_suite)
+    assert mutation_audit.main() == 1
+    summary = _audit_json(capsys)
+    assert [s["name"] for s in summary["survived"]] == [survivor]
+    assert summary["survived"][0]["property"]  # names the broken property
+
+
+def test_mutation_restores_source_even_when_suite_run_crashes(
+    monkeypatch, capsys
+):
+    """A crash mid-run must not leave the temp copy mutated (the finally
+    restore) and must exit the distinct crash code 3 with a JSON error
+    line — never rc 1, which means 'a mutant survived'."""
+    calls = []
+    seen_texts = []
+    kept_dirs = []
+
+    def fake_run_suite(copy):
+        calls.append(copy)
+        if len(calls) == 1:
+            return _FakeProc(0)
+        seen_texts.append((copy / mutation_audit.MUTATIONS[0][1]).read_text())
+        raise RuntimeError("pytest runner died")
+
+    real_rmtree = shutil.rmtree  # the patch below is module-global
+
+    def keep_dir(path, ignore_errors=False):
+        kept_dirs.append(path)  # skip cleanup so the restore is observable
+
+    monkeypatch.setattr(mutation_audit, "make_copy", _fake_sources_only)
+    monkeypatch.setattr(mutation_audit, "run_suite", fake_run_suite)
+    monkeypatch.setattr(mutation_audit.shutil, "rmtree", keep_dir)
+    try:
+        assert mutation_audit.main() == 3
+        summary = _audit_json(capsys)
+        assert summary["error"] == "audit_crashed"
+        assert "RuntimeError" in summary["detail"]
+        name, relpath, old, new, _prop = mutation_audit.MUTATIONS[0]
+        # The mutated text was in place when the run crashed (the audit
+        # was really measuring the mutant, not the pristine source)...
+        assert new in seen_texts[0] and old not in seen_texts[0]
+        # ...and the finally-restore put the pristine source back even
+        # though the run raised.
+        restored = (calls[1] / relpath).read_text()
+        assert restored == (mutation_audit.REPO / relpath).read_text()
+    finally:
+        for path in kept_dirs:
+            real_rmtree(path, ignore_errors=True)
+
+
+def test_red_clean_copy_exits_2_without_applying_mutants(monkeypatch, capsys):
+    runs = []
+
+    def fake_run_suite(copy):
+        runs.append(copy)
+        return _FakeProc(1, stdout="1 failed")
+
+    monkeypatch.setattr(mutation_audit, "make_copy", _fake_sources_only)
+    monkeypatch.setattr(mutation_audit, "run_suite", fake_run_suite)
+    assert mutation_audit.main() == 2
+    assert _audit_json(capsys)["error"] == "clean_copy_suite_red"
+    assert len(runs) == 1  # no mutated runs after an unmeasurable baseline
+
+
+def test_stale_pattern_counts_as_survived(monkeypatch, capsys):
+    stale = ("stale-mutant", "bench.py", "THIS PATTERN DOES NOT EXIST", "x", "p")
+    monkeypatch.setattr(
+        mutation_audit, "MUTATIONS", (stale,) + mutation_audit.MUTATIONS[1:]
+    )
+    calls = []
+
+    def fake_run_suite(copy):
+        calls.append(copy)
+        return _FakeProc(0 if len(calls) == 1 else 1)
+
+    monkeypatch.setattr(mutation_audit, "make_copy", _fake_sources_only)
+    monkeypatch.setattr(mutation_audit, "run_suite", fake_run_suite)
+    assert mutation_audit.main() == 1
+    summary = _audit_json(capsys)
+    assert summary["survived"] == [
+        {"name": "stale-mutant", "reason": "pattern_missing", "property": "p"}
+    ]
