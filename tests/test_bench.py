@@ -292,3 +292,13 @@ def test_e2e_populated_reference(e2e):
     assert result["value"] == 3
     assert result["verification"]["exit_code"] == verify_reference.EXIT_DRIFT
     assert (run.repo / verify_reference.MANIFEST_NAME).exists()
+
+
+def test_exc_detail_empty_message_falls_back_to_class_name():
+    """str(exc) can be empty (bare OSError()); the detail must still
+    name the class instead of degrading to 'ClassName: '."""
+    assert bench.exc_detail(OSError()) == "OSError"
+    assert bench.exc_detail(OSError(5, "Input/output error")).startswith(
+        "OSError: "
+    )
+    assert len(bench.exc_detail(ValueError("x" * 1000))) <= 200
