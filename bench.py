@@ -29,6 +29,11 @@ code 0 — the driver contract):
 - ``reference_scan_error`` — the mount passed the initial checks but the
   recursive walk raised OSError partway through (stale mount, entry
   vanishing mid-iteration, unreadable subtree); value -1.
+- ``bench_internal_error`` — anything unexpected escaped the states
+  above (a repo bug, not evidence about the reference); value -1, with
+  an ``error`` field carrying the detail. The contract holds even when
+  bench itself is broken — a crash must never exit nonzero with no JSON
+  line, and must never masquerade as an authoritative empty tree.
 
 The JSON line also embeds a ``verification`` object — the fingerprint
 comparison from verify_reference.verify() — because this is the one
@@ -180,10 +185,27 @@ def verification_summary(reference: pathlib.Path, repo: pathlib.Path, scan_resul
 
 
 def main() -> int:
-    reference = pathlib.Path(os.environ.get("GRAFT_REFERENCE_PATH", DEFAULT_REFERENCE))
-    repo = pathlib.Path(os.environ.get("GRAFT_REPO_PATH", _REPO_DIR))
-    result = scan(reference)
-    result["verification"] = verification_summary(reference, repo, result)
+    try:
+        reference = pathlib.Path(
+            os.environ.get("GRAFT_REFERENCE_PATH", DEFAULT_REFERENCE)
+        )
+        repo = pathlib.Path(os.environ.get("GRAFT_REPO_PATH", _REPO_DIR))
+        result = scan(reference)
+        result["verification"] = verification_summary(reference, repo, result)
+    except Exception as exc:  # noqa: BLE001 — the driver contract outranks
+        # scan() guards OSError and verification_summary guards itself,
+        # but anything escaping here would exit rc 1 with a traceback and
+        # ZERO JSON lines — breaking the very contract this module exists
+        # to uphold. Degrade to a distinct error metric instead: the
+        # crash stays visible (never reported as an empty tree), the
+        # contract stays intact.
+        result = {
+            "metric": "bench_internal_error",
+            "value": -1,
+            "unit": "reference_entries",
+            "vs_baseline": None,
+            "error": exc_detail(exc),
+        }
     print(json.dumps(result))
     return 0
 

@@ -10,8 +10,8 @@ and requires every mutant to be KILLED (suite goes red). A SURVIVED
 mutant means a documented honesty property is no longer test-enforced —
 the one way this repo can silently rot.
 
-Not a test itself (deliberately not named test_*): ~10 pytest
-subprocess runs cost ~40s wall-clock on this 1-CPU image, too slow for
+Not a test itself (deliberately not named test_*): the ~13 pytest
+subprocess runs cost ~80s wall-clock on this 1-CPU image, too slow for
 the regular suite the SKILL.md says to keep fast. Run on demand:
 
     python tests/mutation_audit.py            # rc 0 iff all mutants killed
@@ -136,6 +136,20 @@ MUTATIONS = (
         '    print(json.dumps(result))\n    return 0',
         '    print(json.dumps(result))\n    print("extra")\n    return 0',
         "bench must print exactly one JSON line (driver contract)",
+    ),
+    (
+        "import-crash-exits-1",
+        "verify_reference.py",
+        '    sys.exit(EXIT_INTERNAL_ERROR)',
+        '    sys.exit(1)',
+        "a bench-import failure at gate load must exit rc 4, never collide with drift's rc 1",
+    ),
+    (
+        "bench-crash-masquerades-as-empty",
+        "bench.py",
+        '            "metric": "bench_internal_error",\n            "value": -1,',
+        '            "metric": "non_graftable_reference_is_empty",\n            "value": 0,',
+        "a bench crash must degrade to a visible error metric, never an authoritative empty-tree report",
     ),
 )
 

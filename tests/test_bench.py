@@ -193,6 +193,37 @@ def test_broken_verification_cannot_break_contract(
     }
 
 
+def test_unexpected_crash_degrades_to_error_metric(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """Anything escaping scan()'s own guards must degrade to the distinct
+    bench_internal_error metric — one JSON line, rc 0, the crash visible
+    in an error field — never a nonzero exit with zero JSON lines
+    (breaking the driver contract) and never a report shaped like an
+    authoritative empty tree."""
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(tmp_path / "ref"))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(fake_repo))
+
+    def boom(reference):
+        raise RuntimeError("unexpected bench bug")
+
+    monkeypatch.setattr(bench, "scan", boom)
+    rc = bench.main()
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert captured.err == ""
+    lines = captured.out.splitlines()
+    assert len(lines) == 1
+    result = json.loads(lines[0])
+    assert result == {
+        "metric": "bench_internal_error",
+        "value": -1,
+        "unit": "reference_entries",
+        "vs_baseline": None,
+        "error": "RuntimeError: unexpected bench bug",
+    }
+
+
 def test_fingerprint_corrupt_surfaces_in_verification(
     tmp_path, fake_repo, monkeypatch, capsys
 ):

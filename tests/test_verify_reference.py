@@ -19,7 +19,10 @@ import hashlib
 import json
 import os
 import pathlib
+import shutil
 import stat as stat_module
+import subprocess
+import sys
 import time
 
 import pytest
@@ -482,6 +485,57 @@ def test_gate_crash_exits_4_not_1(tmp_path, fake_repo, monkeypatch, capsys):
     assert result["error"] == "internal_error"
     assert result["detail"] == "RuntimeError: gate exploded"
     assert "repo bug" in result["note"]
+
+
+def test_broken_bench_import_exits_4_not_1(tmp_path):
+    """A missing or broken bench.py is the one crash main()'s rc-4
+    catch-all cannot see — the import runs at module load, before main()
+    exists — so without its own guard the gate would exit Python's
+    default status 1, colliding with EXIT_DRIFT. Must run as a true
+    subprocess: the guard is module-level and the live test process has
+    already imported a working bench. ``-S`` keeps it cheap (both
+    scripts are stdlib-only; sitecustomize's jax import is irrelevant to
+    the import-failure plumbing under test)."""
+    from conftest import REPO, _clean_env
+
+    shutil.copy2(REPO / "verify_reference.py", tmp_path / "verify_reference.py")
+    (tmp_path / "bench.py").write_text("raise RuntimeError('bench import boom')\n")
+    env = _clean_env()
+    proc = subprocess.run(
+        [sys.executable, "-S", str(tmp_path / "verify_reference.py")],
+        capture_output=True,
+        text=True,
+        cwd="/tmp",
+        env=env,
+        timeout=60,
+    )
+    assert proc.returncode == verify_reference.EXIT_INTERNAL_ERROR == 4
+    result = parse_single_json_line(proc.stdout)
+    assert result["error"] == "internal_error"
+    assert result["detail"] == "RuntimeError: bench import boom"
+    assert "could not import" in result["note"]
+    # And importers must still see the real error, not a sys.exit: the
+    # lazy `import verify_reference` inside bench.verification_summary
+    # degrades on exceptions, so a raise reaches its error field while a
+    # SystemExit would kill bench outright.
+    probe = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "try:\n"
+        "    import verify_reference\n"
+        "except RuntimeError as exc:\n"
+        "    assert str(exc) == 'bench import boom'\n"
+        "    sys.exit(0)\n"
+        "sys.exit(5)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-S", "-c", probe, str(tmp_path)],
+        capture_output=True,
+        text=True,
+        cwd="/tmp",
+        env=env,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
 
 
 def test_stale_manifest_tmp_files_are_swept(tmp_path, fake_repo, monkeypatch, capsys):

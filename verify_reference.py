@@ -53,8 +53,9 @@ misread one as another):
      or went stale mid-walk, or a sidecar exists but could not be
      read. This is NOT evidence the surveyed state changed;
      investigate the environment and re-run.
-- 4  the gate itself crashed (unhandled exception). Printed as a
-     one-line JSON error; a repo bug to fix, carrying no evidence
+- 4  the gate itself crashed (unhandled exception anywhere, including
+     a failure to import its own bench module at load time). Printed
+     as a one-line JSON error; a repo bug to fix, carrying no evidence
      about the reference either way. Distinct from rc 1 so a crash
      can never read as "genuine drift".
 
@@ -88,8 +89,39 @@ import sys
 import tempfile
 import time
 
+EXIT_MATCH = 0
+EXIT_DRIFT = 1
+EXIT_FINGERPRINT_CORRUPT = 2
+EXIT_TRANSIENT = 3
+EXIT_INTERNAL_ERROR = 4
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
-import bench  # the accessibility check + guarded walk live in ONE place
+try:
+    import bench  # the accessibility check + guarded walk live in ONE place
+except Exception as exc:  # noqa: BLE001 — rc must stay meaningful
+    # main()'s rc-4 catch-all cannot see this: the import runs at module
+    # load, before main() exists. Without a guard, a missing or broken
+    # bench.py would exit with Python's default status 1 — the one
+    # remaining path by which a gate crash could read as "genuine drift"
+    # (EXIT_DRIFT) to an exit-code-only consumer. bench.exc_detail is
+    # unavailable here by definition, so the detail is formatted inline.
+    if __name__ != "__main__":
+        raise  # importers (tests, bench's lazy embed) need the real error
+    print(
+        json.dumps(
+            {
+                "check": "reference_verification",
+                "error": "internal_error",
+                "detail": f"{exc.__class__.__name__}: {exc}"[:200],
+                "note": (
+                    "the gate could not import its bench module — a repo "
+                    "bug, not evidence about the reference; fix the repo "
+                    "and re-run"
+                ),
+            }
+        )
+    )
+    sys.exit(EXIT_INTERNAL_ERROR)
 
 DEFAULT_REFERENCE = "/root/reference"
 FINGERPRINT_NAME = "reference_fingerprint.json"
@@ -124,12 +156,6 @@ ROUND_ARTIFACT_PATTERNS = (
     "PAPERS.md",
     "SNIPPETS.md",
 )
-
-EXIT_MATCH = 0
-EXIT_DRIFT = 1
-EXIT_FINGERPRINT_CORRUPT = 2
-EXIT_TRANSIENT = 3
-EXIT_INTERNAL_ERROR = 4
 
 
 def _sha256_of_fd(fd: int) -> str:
