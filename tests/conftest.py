@@ -18,6 +18,10 @@ irrelevant to the argv/env/stdout/rc plumbing under test). All four are
 launched concurrently, but only on the FIRST request of the ``e2e``
 fixture — a partial run (``-k``, ``--collect-only``) that deselects the
 e2e tests never spawns them and never touches the real mount or repo.
+(One more test spawns ``-S`` subprocesses outside this fixture:
+test_broken_bench_import_exits_4_not_1 exercises the gate's
+module-level import guard, which is unreachable in-process by
+construction; ``-S`` keeps those spawns ~ms, not ~1.7s.)
 """
 
 import hashlib
