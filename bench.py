@@ -168,6 +168,8 @@ def verification_summary(reference: pathlib.Path, repo: pathlib.Path, scan_resul
                 summary["manifest"] = result["manifest"]
             if "manifest_error" in result:
                 summary["manifest_error"] = result["manifest_error"]
+            if "mount_type_error" in result:
+                summary["mount_type_error"] = result["mount_type_error"]
             # Round-artifact hygiene: only worth a line in the driver
             # artifact when something is actually uncommitted.
             if result.get("uncommitted_round_artifacts"):

@@ -145,6 +145,16 @@ MUTATIONS = (
         "a bench-import failure at gate load must exit rc 4, never collide with drift's rc 1",
     ),
     (
+        "mount-type-swap-reads-as-transient",
+        "verify_reference.py",
+        '        mount_state, mount_detail = observe_mount_type(reference)\n'
+        '        if mount_state == MOUNT_NOT_A_DIR:',
+        '        mount_state, mount_detail = observe_mount_type(reference)\n'
+        '        if False:',
+        "a file/FIFO/symlink-loop AT the mount path is a persistent state change "
+        "(rc 1, type named), never a transient 're-run and it'll clear' (rc 3)",
+    ),
+    (
         "bench-crash-masquerades-as-empty",
         "bench.py",
         '            "metric": "bench_internal_error",\n            "value": -1,',

@@ -101,11 +101,20 @@ def test_missing_reference(tmp_path, fake_repo, monkeypatch, capsys):
 
 
 def test_reference_is_not_a_directory(tmp_path, fake_repo, monkeypatch, capsys):
+    """bench's metric stays state-neutral (its job is observation, not
+    verdict), while the embedded verification carries the gate's
+    discrimination: a file AT the mount path is persistent drift
+    (rc 1, type named), not a transient failure."""
     not_a_dir = tmp_path / "file"
     not_a_dir.write_text("x")
     result = run_main(monkeypatch, capsys, not_a_dir, fake_repo)
     assert result["metric"] == "reference_mount_missing_or_unreadable"
     assert result["value"] == -1
+    verification = result["verification"]
+    assert verification["exit_code"] == verify_reference.EXIT_DRIFT
+    assert verification["transient_environment_failure"] is False
+    assert verification["mount_type_error"].startswith("not a directory:")
+    assert "NOT a directory" in verification["note"]
 
 
 def test_unreadable_reference(tmp_path):

@@ -4,11 +4,12 @@ Contract: exactly one JSON line on stdout; exit codes are distinct per
 failure mode so exit-code-only consumers can never conflate them:
 0 = live state matches the committed fingerprint; 1 = genuine drift
 (reference tree non-empty, sidecar content changed, a sidecar appearing
-or disappearing); 2 = the fingerprint itself is missing or corrupt;
-3 = transient environment failure (mount absent/unreadable/stale, or a
-sidecar that exists but cannot be read) — NOT evidence the surveyed
-state changed; 4 = the gate itself crashed (never conflated with
-drift's rc 1).
+or disappearing, or the mount path existing as a non-directory — a
+file/FIFO/symlink loop in its place); 2 = the fingerprint itself is
+missing or corrupt; 3 = transient environment failure (mount absent —
+including a dangling symlink — /unreadable/stale, or a sidecar that
+exists but cannot be read) — NOT evidence the surveyed state changed;
+4 = the gate itself crashed (never conflated with drift's rc 1).
 
 A non-empty observed tree must additionally produce a per-file manifest
 (reference_manifest_observed.json) to bootstrap the mandated SURVEY.md
@@ -265,6 +266,130 @@ def test_scan_error_is_transient_exits_3(tmp_path, fake_repo, monkeypatch, capsy
     assert rc == verify_reference.EXIT_TRANSIENT
     assert result["observed"]["reference_entry_count"] == "scan_error"
     assert result["transient_environment_failure"] is True
+
+
+def test_file_at_mount_path_is_drift_exits_1(tmp_path, fake_repo, monkeypatch, capsys):
+    """A regular file sitting AT the mount path is a persistent state
+    change — rc 1 with the type named, never rc 3's "re-run and it'll
+    clear" (the same conflation class the sidecars shed in round 4)."""
+    ref = tmp_path / "ref"
+    ref.write_text("I am not a directory\n")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT == 1
+    assert result["transient_environment_failure"] is False
+    assert result["observed"]["reference_entry_count"] == "mount_not_a_directory"
+    assert {d["fact"] for d in result["drift"]} == {"reference_entry_count"}
+    assert result["mount_type_error"].startswith("not a directory: -")
+    assert "NOT a directory" in result["note"]
+    assert "persistent" in result["note"]
+
+
+def test_symlink_to_file_at_mount_path_is_drift_exits_1(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """The observation follows symlinks (like bench.scan's is_dir): a
+    symlink whose target is a file is still a non-directory mount."""
+    target = tmp_path / "target"
+    target.write_text("x\n")
+    ref = tmp_path / "ref"
+    ref.symlink_to(target)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["observed"]["reference_entry_count"] == "mount_not_a_directory"
+    assert result["mount_type_error"].startswith("not a directory:")
+
+
+def test_fifo_at_mount_path_is_drift_and_cannot_hang(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A writer-less FIFO at the mount path must classify as drift
+    WITHOUT blocking the gate: the O_NONBLOCK open + fstat pattern
+    (same as observe_sidecar) is what makes this test terminate."""
+    ref = tmp_path / "ref"
+    os.mkfifo(ref)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["transient_environment_failure"] is False
+    assert result["observed"]["reference_entry_count"] == "mount_not_a_directory"
+    # filemode of a FIFO starts with 'p'; the permission bits depend on
+    # the umask, so only the type character is asserted.
+    assert result["mount_type_error"].startswith("not a directory: p")
+
+
+def test_symlink_loop_at_mount_path_is_drift_exits_1(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    ref = tmp_path / "ref"
+    ref.symlink_to(ref)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["observed"]["reference_entry_count"] == "mount_not_a_directory"
+    assert "Too many levels of symbolic links" in result["mount_type_error"]
+
+
+def test_dangling_symlink_at_mount_path_is_transient_exits_3(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A dangling symlink resolves to nothing: for the MOUNT that is
+    absence (transient — the driver recreates the mount every round),
+    mirroring observe_sidecar where a dangling symlink is 'absent'."""
+    ref = tmp_path / "ref"
+    ref.symlink_to(tmp_path / "nowhere")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_TRANSIENT == 3
+    assert result["observed"]["reference_entry_count"] == "mount_missing_or_unreadable"
+    assert result["transient_environment_failure"] is True
+    assert "mount_type_error" not in result
+
+
+def test_unreadable_mount_type_observation_stays_transient(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """If the type observation itself hits a permissions hiccup (any
+    OSError other than ELOOP/ENXIO/absence), the true state is unknown
+    — rc 3, never escalated to drift."""
+    ref = tmp_path / "ref"
+    ref.write_text("wrong type, but unreadable\n")
+    real_open = os.open
+
+    def deny(path, flags, *args, **kwargs):
+        if pathlib.Path(path) == ref:
+            raise PermissionError(13, "Permission denied", str(path))
+        return real_open(path, flags, *args, **kwargs)
+
+    monkeypatch.setattr(os, "open", deny)
+    # bench.scan's os.access also consults the real file; PermissionError
+    # from os.open is what scan's is_dir/access path never sees, so force
+    # the scan-side inaccessibility too.
+    monkeypatch.setattr(os, "access", lambda *a, **k: False)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_TRANSIENT
+    assert result["observed"]["reference_entry_count"] == "mount_missing_or_unreadable"
+    assert result["transient_environment_failure"] is True
+
+
+def test_mount_healthy_again_by_observation_time_stays_transient(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """Race arm: the scan said inaccessible but the type observation
+    sees a healthy directory — the earlier failure stands as transient
+    (a re-run will see the directory), never as wrong-type drift."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    monkeypatch.setattr(
+        bench,
+        "scan",
+        lambda reference: {
+            "metric": "reference_mount_missing_or_unreadable",
+            "value": -1,
+            "unit": "reference_entries",
+            "vs_baseline": None,
+        },
+    )
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_TRANSIENT
+    assert result["observed"]["reference_entry_count"] == "mount_missing_or_unreadable"
+    assert "mount_type_error" not in result
 
 
 def test_changed_baseline_sidecar_is_drift_exits_1(

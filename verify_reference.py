@@ -41,18 +41,23 @@ misread one as another):
 
 - 0  everything matches the fingerprint: reference still empty,
      sidecars unchanged; the non-graftable verdict stands.
-- 1  genuine drift: the reference tree is non-empty or a readable
+- 1  genuine drift: the reference tree is non-empty, a readable
      sidecar's content changed (including a sidecar appearing,
-     disappearing, or being replaced by a directory). If the tree is
-     non-empty, SURVEY.md is obsolete —
-     rewrite it from the real tree before writing any code (see
-     SURVEY_REWRITE.md for the mandated procedure).
+     disappearing, or being replaced by a directory), or the mount
+     path itself exists but is not a directory (a file/FIFO/socket/
+     symlink loop in its place — a persistent state change, named in
+     the note and in `mount_type_error`). If the tree is non-empty,
+     SURVEY.md is obsolete — rewrite it from the real tree before
+     writing any code (see SURVEY_REWRITE.md for the mandated
+     procedure).
 - 2  could not gather evidence: fingerprint missing or corrupt
      (repo bug, fix the fingerprint).
-- 3  transient environment failure: the mount is absent, unreadable,
-     or went stale mid-walk, or a sidecar exists but could not be
-     read. This is NOT evidence the surveyed state changed;
-     investigate the environment and re-run.
+- 3  transient environment failure: the mount is absent (including a
+     dangling symlink — the mount is recreated every round, so
+     absence means the environment is not ready), unreadable, or went
+     stale mid-walk, or a sidecar exists but could not be read. This
+     is NOT evidence the surveyed state changed; investigate the
+     environment and re-run.
 - 4  the gate itself crashed (unhandled exception anywhere, including
      a failure to import its own bench module at load time). Printed
      as a one-line JSON error; a repo bug to fix, carrying no evidence
@@ -137,6 +142,17 @@ COMPARED_KEYS = ("reference_entry_count",) + tuple(SIDECAR_FILES)
 SIDECAR_ABSENT = "absent"
 SIDECAR_UNREADABLE = "unreadable"
 SIDECAR_NOT_A_FILE = "not-a-regular-file"
+# Mount-type observation states (observe_mount_type). The first is the
+# only healthy one; NOT_A_DIR is the persistent wrong-type state that
+# must classify as genuine drift, the other two stay transient.
+MOUNT_DIR = "dir"
+MOUNT_ABSENT = "absent"
+MOUNT_NOT_A_DIR = "not-a-directory"
+MOUNT_UNREADABLE = "unreadable"
+# Observed-count sentinel for the wrong-type state, so the drift entry
+# itself names what was found instead of the generic accessibility
+# sentinel (which remains for the genuinely transient states).
+COUNT_NOT_A_DIRECTORY = "mount_not_a_directory"
 # Orphaned manifest temp files older than this are swept; younger ones
 # may belong to a concurrent run mid-write and must be left alone.
 STALE_TMP_AGE_S = 3600
@@ -224,6 +240,57 @@ def observe_sidecar(path: pathlib.Path):
         return _sha256_of_fd(fd), None
     except OSError as exc:
         return SIDECAR_UNREADABLE, bench.exc_detail(exc)
+    finally:
+        os.close(fd)
+
+
+def observe_mount_type(reference: pathlib.Path):
+    """Four-state mount-type observation; returns (state, detail).
+
+    bench.scan deliberately folds every inaccessible-mount state into
+    one metric (its job is a state-neutral observation, not a verdict);
+    this function supplies the gate's verdict-grade discrimination,
+    with the same race-free pattern as observe_sidecar (O_NONBLOCK
+    open, then fstat of the OPEN descriptor — a stat-then-open pair
+    would leave a TOCTOU window, and a blocking open of a FIFO sitting
+    at the mount path would hang the gate forever):
+
+    - "dir": the path opens and fstats as a directory. Reachable only
+      in a race (the scan failed moments earlier) — classified
+      transient by the caller, a re-run will see the directory.
+    - "absent": the path does not exist (FileNotFoundError, including
+      a dangling symlink — mirroring observe_sidecar, where a dangling
+      symlink is "absent"). For the MOUNT this is transient (rc 3):
+      the driver recreates the mount every round, so absence means the
+      environment is not ready, unlike a sidecar's absence which is a
+      content fact.
+    - "not-a-directory": the path EXISTS but is a regular file, FIFO,
+      device (fstat), socket (ENXIO), or symlink loop (ELOOP). A
+      persistent state change — not a read hiccup a re-run could
+      clear — so the caller classifies it as genuine drift (rc 1),
+      exactly the doctrine the sidecars got in round 4. detail names
+      the type (filemode or errno detail).
+    - "unreadable": any other OSError (permissions hiccup, flaky
+      disk). True state unknown — transient (rc 3), never drift.
+    """
+    try:
+        fd = os.open(reference, os.O_RDONLY | os.O_NONBLOCK)
+    except FileNotFoundError:
+        return MOUNT_ABSENT, None
+    except OSError as exc:
+        if exc.errno in (errno.ELOOP, errno.ENXIO):
+            return MOUNT_NOT_A_DIR, bench.exc_detail(exc)
+        return MOUNT_UNREADABLE, bench.exc_detail(exc)
+    try:
+        st = os.fstat(fd)
+        if stat_module.S_ISDIR(st.st_mode):
+            return MOUNT_DIR, None
+        return (
+            MOUNT_NOT_A_DIR,
+            "not a directory: " + stat_module.filemode(st.st_mode),
+        )
+    except OSError as exc:
+        return MOUNT_UNREADABLE, bench.exc_detail(exc)
     finally:
         os.close(fd)
 
@@ -519,12 +586,27 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
         )
 
     observed, sidecar_errors = gather(reference, repo, scan_result)
+    count = observed["reference_entry_count"]
+    mount_type_error = None
+    if count == "mount_missing_or_unreadable":
+        # bench.scan's accessibility boolean folds "absent" and "wrong
+        # type" together (deliberately — its metric is state-neutral).
+        # The gate must not: a regular file / FIFO / symlink loop
+        # sitting AT the mount path is a persistent state change, not a
+        # transient failure a re-run could clear. Discriminate here so
+        # the drift entry and the exit code tell the truth. If the
+        # observation now sees a healthy directory (or plain absence),
+        # the earlier scan failure stands as transient.
+        mount_state, mount_detail = observe_mount_type(reference)
+        if mount_state == MOUNT_NOT_A_DIR:
+            count = COUNT_NOT_A_DIRECTORY
+            observed["reference_entry_count"] = count
+            mount_type_error = mount_detail
     drift = [
         {"fact": key, "fingerprint": fingerprint.get(key), "observed": observed[key]}
         for key in COMPARED_KEYS
         if observed[key] != fingerprint.get(key)
     ]
-    count = observed["reference_entry_count"]
     mount_transient = count in ("mount_missing_or_unreadable", "scan_error")
     unreadable_sidecars = sorted(
         SIDECAR_FILES[key]
@@ -604,6 +686,15 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
             + ". Sidecar-only drift (PAPERS/SNIPPETS) does not add "
             "capabilities: only the mounted tree defines what to build."
         )
+        if count == COUNT_NOT_A_DIRECTORY:
+            note += (
+                " NOTE: the reference mount path exists but is NOT a "
+                "directory ("
+                + (mount_type_error or "unknown type")
+                + ") — a persistent state change, not a transient mount "
+                "failure; there is no tree to survey behind a "
+                "non-directory, so investigate how the mount was created."
+            )
         if mount_transient:
             note += (
                 " NOTE: the mount itself could not be scanned this run "
@@ -634,6 +725,8 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
         result["sidecar_errors"] = sidecar_errors
     if manifest_error is not None:
         result["manifest_error"] = manifest_error
+    if mount_type_error is not None:
+        result["mount_type_error"] = mount_type_error
     return result, exit_code
 
 
