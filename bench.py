@@ -33,7 +33,12 @@ code 0 — the driver contract):
   above (a repo bug, not evidence about the reference); value -1, with
   an ``error`` field carrying the detail. The contract holds even when
   bench itself is broken — a crash must never exit nonzero with no JSON
-  line, and must never masquerade as an authoritative empty tree.
+  line, and must never masquerade as an authoritative empty tree. This
+  covers serialization and print failures too (both sit inside the
+  guard; the fallback line is built from literals). The single
+  physically-unguardable case is stdout itself being unwritable: no
+  line is possible then, and bench exits 1 so the empty output reads
+  as the failure it is instead of a silent rc-0 success.
 
 The JSON line also embeds a ``verification`` object — the fingerprint
 comparison from verify_reference.verify() — because this is the one
@@ -69,10 +74,18 @@ def exc_detail(exc: BaseException, limit: int = 200) -> str:
     errno/path is exactly what the investigating session needs.
     json.dumps escapes newlines, so embedding this in the one-line
     stdout contract is safe; truncation keeps a pathological message
-    from bloating the line. Lives here (not verify_reference) because
-    the import dependency is bench <- verify_reference.
+    from bloating the line. str(exc) is guarded: this function runs
+    inside every degradation path, so an exception whose own __str__
+    raises must not turn a recoverable crash into an unrecoverable one
+    (bench's fallback error line depends on this never raising — only
+    a genuinely unwritable stdout may defeat that fallback).
+    Lives here (not verify_reference) because the import dependency is
+    bench <- verify_reference.
     """
-    message = str(exc)
+    try:
+        message = str(exc)
+    except Exception:  # noqa: BLE001 — a raising __str__ must not cascade
+        message = "<exception message unavailable: __str__ raised>"
     if not message:
         return exc.__class__.__name__
     return f"{exc.__class__.__name__}: {message}"[:limit]
@@ -168,6 +181,8 @@ def verification_summary(reference: pathlib.Path, repo: pathlib.Path, scan_resul
                 summary["manifest"] = result["manifest"]
             if "manifest_error" in result:
                 summary["manifest_error"] = result["manifest_error"]
+            if "manifest_shape" in result:
+                summary["manifest_shape"] = result["manifest_shape"]
             if "mount_type_error" in result:
                 summary["mount_type_error"] = result["mount_type_error"]
             # Round-artifact hygiene: only worth a line in the driver
@@ -194,22 +209,35 @@ def main() -> int:
         repo = pathlib.Path(os.environ.get("GRAFT_REPO_PATH", _REPO_DIR))
         result = scan(reference)
         result["verification"] = verification_summary(reference, repo, result)
+        print(json.dumps(result))
+        return 0
     except Exception as exc:  # noqa: BLE001 — the driver contract outranks
         # scan() guards OSError and verification_summary guards itself,
         # but anything escaping here would exit rc 1 with a traceback and
         # ZERO JSON lines — breaking the very contract this module exists
-        # to uphold. Degrade to a distinct error metric instead: the
-        # crash stays visible (never reported as an empty tree), the
-        # contract stays intact.
-        result = {
-            "metric": "bench_internal_error",
-            "value": -1,
-            "unit": "reference_entries",
-            "vs_baseline": None,
-            "error": exc_detail(exc),
-        }
-    print(json.dumps(result))
-    return 0
+        # to uphold. The print and the serialization sit INSIDE the try
+        # (a result json.dumps cannot serialize, or a failing stdout,
+        # are crashes like any other), and the fallback line is built
+        # from literals so it cannot fail the same way. The crash stays
+        # visible (never reported as an empty tree); the contract stays
+        # intact.
+        try:
+            failure = {
+                "metric": "bench_internal_error",
+                "value": -1,
+                "unit": "reference_entries",
+                "vs_baseline": None,
+                "error": exc_detail(exc),
+            }
+            print(json.dumps(failure))
+            return 0
+        except Exception:  # noqa: BLE001 — stdout itself is broken
+            # Even the literal fallback could not be printed: stdout is
+            # unwritable, so NO JSON line is physically possible and the
+            # one-line/rc-0 contract cannot be met. Exit nonzero so the
+            # empty output reads as the failure it is — a silent rc 0
+            # with no line would be a fake success.
+            return 1  # no JSON line was possible
 
 
 if __name__ == "__main__":

@@ -10,9 +10,11 @@ and requires every mutant to be KILLED (suite goes red). A SURVIVED
 mutant means a documented honesty property is no longer test-enforced —
 the one way this repo can silently rot.
 
-Not a test itself (deliberately not named test_*): the ~13 pytest
-subprocess runs cost ~80s wall-clock on this 1-CPU image, too slow for
-the regular suite the SKILL.md says to keep fast. Run on demand:
+Not a test itself (deliberately not named test_*): every mutant costs
+a full pytest subprocess run (~6-7s on this 1-CPU image), plus one
+clean-baseline run — minutes of wall-clock across the MUTATIONS list,
+too slow for the regular suite the SKILL.md says to keep fast. Run on
+demand:
 
     python tests/mutation_audit.py            # rc 0 iff all mutants killed
 
@@ -133,9 +135,17 @@ MUTATIONS = (
     (
         "bench-breaks-one-line-contract",
         "bench.py",
-        '    print(json.dumps(result))\n    return 0',
-        '    print(json.dumps(result))\n    print("extra")\n    return 0',
+        '        print(json.dumps(result))\n        return 0',
+        '        print(json.dumps(result))\n        print("extra")\n        return 0',
         "bench must print exactly one JSON line (driver contract)",
+    ),
+    (
+        "bench-print-failure-reads-as-success",
+        "bench.py",
+        '            return 1  # no JSON line was possible',
+        '            return 0  # no JSON line was possible',
+        "when stdout is unwritable and no JSON line can exist, bench must not "
+        "exit 0 — an empty rc-0 output would be a fake success",
     ),
     (
         "import-crash-exits-1",
@@ -155,10 +165,18 @@ MUTATIONS = (
         "(rc 1, type named), never a transient 're-run and it'll clear' (rc 3)",
     ),
     (
+        "bare-git-tree-reads-as-working-source",
+        "verify_reference.py",
+        '    top = {entry["path"].split("/", 1)[0] for entry in entries}',
+        '    top = set()',
+        "a VCS-metadata-only remount (bare/hidden .git) must be classified and "
+        "flagged for materialization, never surveyed as a plain source tree",
+    ),
+    (
         "bench-crash-masquerades-as-empty",
         "bench.py",
-        '            "metric": "bench_internal_error",\n            "value": -1,',
-        '            "metric": "non_graftable_reference_is_empty",\n            "value": 0,',
+        '                "metric": "bench_internal_error",\n                "value": -1,',
+        '                "metric": "non_graftable_reference_is_empty",\n                "value": 0,',
         "a bench crash must degrade to a visible error metric, never an authoritative empty-tree report",
     ),
 )

@@ -16,6 +16,7 @@ cannot reach as root is exercised by monkeypatching os.access.
 import json
 import os
 import pathlib
+import sys
 
 import bench
 import verify_reference
@@ -90,6 +91,9 @@ def test_populated_reference(tmp_path, fake_repo, monkeypatch, capsys):
     assert {d["fact"] for d in verification["drift"]} == {"reference_entry_count"}
     assert "DRIFT" in verification["note"]
     assert pathlib.Path(verification["manifest"]).read_text()  # manifest written
+    # Shape classification rides along so BENCH_r*.json can never show
+    # a VCS-metadata-only remount as a plain source tree.
+    assert verification["manifest_shape"] == "working-tree"
 
 
 def test_missing_reference(tmp_path, fake_repo, monkeypatch, capsys):
@@ -231,6 +235,84 @@ def test_unexpected_crash_degrades_to_error_metric(
         "vs_baseline": None,
         "error": "RuntimeError: unexpected bench bug",
     }
+
+
+def test_unserializable_result_degrades_to_literal_error_line(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A result json.dumps cannot serialize is a crash like any other:
+    the fallback line (built from literals) must still satisfy the
+    one-line/rc-0 contract with the failure visible."""
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(tmp_path / "ref"))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(fake_repo))
+    monkeypatch.setattr(
+        bench,
+        "scan",
+        lambda reference: {
+            "metric": "non_graftable_reference_is_empty",
+            "value": 0,
+            "unit": "reference_entries",
+            "vs_baseline": object(),  # json.dumps chokes on this
+        },
+    )
+    rc = bench.main()
+    captured = capsys.readouterr()
+    assert rc == 0
+    lines = captured.out.splitlines()
+    assert len(lines) == 1
+    result = json.loads(lines[0])
+    assert result["metric"] == "bench_internal_error"
+    assert result["value"] == -1
+    assert result["error"].startswith("TypeError")
+
+
+def test_broken_stdout_exits_nonzero_never_silent_success(
+    tmp_path, fake_repo, monkeypatch
+):
+    """When stdout itself is unwritable no JSON line is physically
+    possible; bench must exit nonzero (the documented single exception
+    to rc 0) rather than report success with empty output."""
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(tmp_path / "ref"))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(fake_repo))
+
+    def broken_write(*args, **kwargs):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(sys.stdout, "write", broken_write)
+    assert bench.main() == 1
+
+
+def test_exception_with_raising_str_still_degrades_cleanly(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """exc_detail runs inside every degradation path, so an exception
+    whose own __str__ raises must not cascade: the fallback line must
+    still print (rc 0, one line) with the class name preserved."""
+
+    class EvilError(Exception):
+        def __str__(self):
+            raise RuntimeError("__str__ is broken too")
+
+    assert (
+        bench.exc_detail(EvilError())
+        == "EvilError: <exception message unavailable: __str__ raised>"
+    )
+
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(tmp_path / "ref"))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(fake_repo))
+
+    def boom(reference):
+        raise EvilError()
+
+    monkeypatch.setattr(bench, "scan", boom)
+    rc = bench.main()
+    captured = capsys.readouterr()
+    assert rc == 0
+    lines = captured.out.splitlines()
+    assert len(lines) == 1
+    result = json.loads(lines[0])
+    assert result["metric"] == "bench_internal_error"
+    assert result["error"].startswith("EvilError")
 
 
 def test_fingerprint_corrupt_surfaces_in_verification(

@@ -116,6 +116,121 @@ def test_populated_reference_writes_manifest(tmp_path, fake_repo, monkeypatch, c
     )
 
 
+def _make_hidden_git_tree(root):
+    """A reference containing ONLY a .git directory — the upstream
+    shape BASELINE.json predicts ("only a bare .git directory")."""
+    git = root / ".git"
+    (git / "objects" / "ab").mkdir(parents=True)
+    (git / "objects" / "ab" / "cdef0123").write_bytes(b"\x78\x9c")
+    (git / "refs" / "heads").mkdir(parents=True)
+    (git / "refs" / "heads" / "main").write_text("0" * 40 + "\n")
+    (git / "HEAD").write_text("ref: refs/heads/main\n")
+    (git / "config").write_text("[core]\n\tbare = false\n")
+    return root
+
+
+def test_hidden_git_only_tree_is_flagged_vcs_metadata_only(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A tree whose every entry is .git/** must NOT read as a plain
+    source tree: the read order for working files finds nothing there,
+    and 'found nothing' must never be mistaken for 'no capabilities' —
+    the real source lives in the object store. The gate classifies the
+    shape and the note directs the reader to materialize first."""
+    ref = _make_hidden_git_tree(tmp_path / "ref")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest_shape"] == "vcs-metadata-only"
+    assert "VERSION-CONTROL METADATA" in result["note"]
+    assert "materialize" in result["note"]
+    assert "SURVEY_REWRITE" in result["note"]
+    manifest = json.loads((fake_repo / verify_reference.MANIFEST_NAME).read_text())
+    assert manifest["shape"] == "vcs-metadata-only"
+    assert "SHAPE WARNING" in manifest["comment"]
+
+
+def test_bare_git_layout_is_flagged_vcs_metadata_only(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """The other VCS-only packaging: the mount IS the git directory
+    (HEAD/objects/refs at top level, no .git wrapper)."""
+    ref = tmp_path / "ref"
+    (ref / "objects" / "pack").mkdir(parents=True)
+    (ref / "objects" / "pack" / "pack-1234.pack").write_bytes(b"PACK")
+    (ref / "refs" / "heads").mkdir(parents=True)
+    (ref / "HEAD").write_text("ref: refs/heads/main\n")
+    (ref / "config").write_text("[core]\n\tbare = true\n")
+    (ref / "packed-refs").write_text("# pack-refs\n")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest_shape"] == "vcs-metadata-only"
+    assert "VERSION-CONTROL METADATA" in result["note"]
+
+
+def test_git_metadata_plus_working_files_is_working_tree(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """Any non-git top-level entry means working files exist: the
+    normal read order applies and no materialize warning fires."""
+    ref = _make_hidden_git_tree(tmp_path / "ref")
+    (ref / "README.md").write_text("real working file\n")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest_shape"] == "working-tree"
+    assert "VERSION-CONTROL METADATA" not in result["note"]
+
+
+def test_bare_like_layout_without_head_is_working_tree(tmp_path):
+    """Strictness arm: git-ish names alone don't trigger the VCS-only
+    classification — the load-bearing HEAD/objects/refs trio must all
+    be present (a tree with 'info' and 'logs' dirs is just a tree)."""
+    entries = [{"path": p} for p in ("info", "logs", "objects", "objects/x")]
+    assert (
+        verify_reference.classify_manifest_shape(entries) == "working-tree"
+    )
+    entries = [
+        {"path": p}
+        for p in ("HEAD", "objects", "objects/x", "refs", "refs/heads")
+    ]
+    assert (
+        verify_reference.classify_manifest_shape(entries) == "vcs-metadata-only"
+    )
+
+
+def test_matching_nonempty_vcs_only_fingerprint_keeps_the_shape_warning(
+    tmp_path, monkeypatch, capsys
+):
+    """After a deliberate re-pin to a VCS-only tree, rc 0 must STILL
+    carry the materialize warning — a match is not permission to survey
+    the metadata as if it were source."""
+    from conftest import make_fake_repo
+
+    ref = _make_hidden_git_tree(tmp_path / "ref")
+    count = sum(len(d) + len(f) for _, d, f in os.walk(ref))
+    repo = make_fake_repo(tmp_path, entry_count=count)
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_MATCH
+    assert "NON-EMPTY" in result["note"]
+    assert result["manifest_shape"] == "vcs-metadata-only"
+    assert "VERSION-CONTROL METADATA" in result["note"]
+
+
+def test_vcs_only_warning_survives_a_failed_manifest_write(
+    tmp_path, fake_repo, deny_manifest_write, monkeypatch, capsys
+):
+    """The shape is evidence from the WALK, not a property of repo-dir
+    writability: a read-only repo dir / full disk on remount day must
+    not silently drop the verdict-critical materialize warning."""
+    ref = _make_hidden_git_tree(tmp_path / "ref")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest"] is None
+    assert result["manifest_error"] == "OSError: read-only file system"
+    assert result["manifest_shape"] == "vcs-metadata-only"
+    assert "VERSION-CONTROL METADATA" in result["note"]
+    assert "materialize" in result["note"]
+
+
 def test_unwritable_manifest_does_not_break_the_gate(
     tmp_path, fake_repo, deny_manifest_write, monkeypatch, capsys
 ):
@@ -804,7 +919,7 @@ def test_scan_count_and_manifest_agree(tmp_path):
         repo.mkdir()
         scanned = bench.scan(tree)["value"]
         assert len(verify_reference.build_manifest(tree)) == scanned, tree
-        manifest_path = verify_reference.write_manifest(tree, repo)
+        manifest_path, _shape = verify_reference.write_manifest(tree, repo)
         written = json.loads(pathlib.Path(manifest_path).read_text())
         assert written["entry_count"] == scanned, tree
 
@@ -873,6 +988,53 @@ def test_uncommitted_round_artifacts_field(tmp_path, monkeypatch, capsys):
 
     git("add", "-A")
     git("commit", "-q", "-m", "artifacts committed")
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert result["uncommitted_round_artifacts"] == []
+
+
+def test_uncommitted_manifest_is_flagged_on_remount_day(
+    tmp_path, monkeypatch, capsys
+):
+    """Remount day is the hygiene backstop's highest-stakes day: the
+    playbook (SURVEY_REWRITE.md step 0.4) mandates committing the
+    observed manifest before reading the tree further, so the gate must
+    flag its OWN just-written manifest as uncommitted in the very same
+    run that wrote it — and stop flagging it once committed."""
+    import subprocess
+
+    from conftest import make_fake_repo, make_populated_reference
+
+    ref = make_populated_reference(tmp_path)
+    repo = make_fake_repo(tmp_path)
+
+    def git(*args):
+        subprocess.run(
+            [
+                "git",
+                "-C",
+                str(repo),
+                "-c",
+                "user.email=t@example.com",
+                "-c",
+                "user.name=t",
+                *args,
+            ],
+            check=True,
+            capture_output=True,
+        )
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-q", "-m", "baseline")
+    rc, result = run_main(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest"] is not None
+    assert result["uncommitted_round_artifacts"] == [
+        verify_reference.MANIFEST_NAME
+    ]
+
+    git("add", verify_reference.MANIFEST_NAME)
+    git("commit", "-q", "-m", "record the observed manifest (playbook 0.4)")
     rc, result = run_main(monkeypatch, capsys, ref, repo)
     assert result["uncommitted_round_artifacts"] == []
 

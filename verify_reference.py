@@ -27,10 +27,13 @@ the results against the committed fingerprint
   as a match).
 
 The JSON line also carries `uncommitted_round_artifacts` — a
-best-effort `git status` over the driver-written files (BENCH_r*.json,
-MULTICHIP_r*.json, VERDICT.md, ADVICE.md, and the fingerprinted
-sidecars BASELINE.json/PAPERS.md/SNIPPETS.md), so the round-start rule
-"commit the previous round's artifacts first" is checked mechanically
+best-effort `git status` over the round evidence files (the
+driver-written BENCH_r*.json, MULTICHIP_r*.json, VERDICT.md,
+ADVICE.md, the fingerprinted sidecars
+BASELINE.json/PAPERS.md/SNIPPETS.md, and the gate-written remount
+manifest reference_manifest_observed.json), so the round-start rule
+"commit the previous round's artifacts first" — and the remount
+playbook's commit-the-manifest-first rule — are checked mechanically
 instead of relying on a session reading prose. Null when the repo dir
 is not a git work tree; never affects the exit code.
 
@@ -71,7 +74,13 @@ symlink (with target) / special (FIFO/socket/device, carrying a `mode`
 field and never opened, so they cannot hang the walk) / error. This is
 evidence to bootstrap the mandated SURVEY.md rewrite, so the
 obsolescence path starts from facts instead of a blank page. stdout
-stays one JSON line.
+stays one JSON line. The manifest (and the gate line's
+`manifest_shape`) also classifies the tree's shape: "working-tree", or
+"vcs-metadata-only" when every entry is git metadata (a bare or hidden
+.git tree — the upstream shape BASELINE.json predicts), in which case
+the note directs the reader to materialize the committed tree before
+surveying, because the absence of working files says nothing about
+capabilities.
 
 The core comparison lives in `verify(reference, repo)` so bench.py can
 embed the same evidence in the driver's mandatory bench line every
@@ -153,16 +162,37 @@ MOUNT_UNREADABLE = "unreadable"
 # itself names what was found instead of the generic accessibility
 # sentinel (which remains for the genuinely transient states).
 COUNT_NOT_A_DIRECTORY = "mount_not_a_directory"
+# Manifest shapes (classify_manifest_shape). BASELINE.json predicts the
+# upstream is "only a bare .git directory": if the driver ever mounts
+# that tree as-is, every observed entry is VCS metadata and the real
+# source (if any) lives in the git object store — a survey of the
+# working files would wrongly conclude "still nothing here".
+MANIFEST_SHAPE_VCS_ONLY = "vcs-metadata-only"
+MANIFEST_SHAPE_WORKING_TREE = "working-tree"
+# Top-level names that together are the anatomy of a bare git
+# repository directory (objects/refs/HEAD are the load-bearing trio;
+# the rest are common companions). Used only as a *subset* test — a
+# tree with any non-git top-level entry classifies as a working tree.
+BARE_GIT_DIR_NAMES = frozenset((
+    "HEAD", "FETCH_HEAD", "ORIG_HEAD", "MERGE_HEAD", "MERGE_MSG",
+    "COMMIT_EDITMSG", "config", "description", "hooks", "info",
+    "objects", "refs", "packed-refs", "branches", "logs", "index",
+    "shallow", "worktrees", "modules",
+))
 # Orphaned manifest temp files older than this are swept; younger ones
 # may belong to a concurrent run mid-write and must be left alone.
 STALE_TMP_AGE_S = 3600
 _SHA256_HEX = re.compile(r"[0-9a-f]{64}")
-# Driver-written files the round-start rule says to commit before any
-# other work; uncommitted_round_artifacts() reports them mechanically.
-# Includes the fingerprinted sidecars: round 4 began with a driver-
-# populated SNIPPETS.md sitting untracked — exactly what this check
-# exists to surface. PROGRESS.jsonl is deliberately excluded: the
-# driver rewrites it mid-round, so it is expected to be dirty.
+# Evidence files the round-start rule says to commit before any other
+# work; uncommitted_round_artifacts() reports them mechanically. Mostly
+# driver-written (BENCH/MULTICHIP/VERDICT/ADVICE and the fingerprinted
+# sidecars: round 4 began with a driver-populated SNIPPETS.md sitting
+# untracked — exactly what this check exists to surface), plus the one
+# GATE-written evidence file, the remount manifest: on remount day the
+# playbook's step 0.4 mandates committing it before reading the tree
+# further, and that is the day the hygiene backstop matters most.
+# PROGRESS.jsonl is deliberately excluded: the driver rewrites it
+# mid-round, so it is expected to be dirty.
 ROUND_ARTIFACT_PATTERNS = (
     "BENCH_r*.json",
     "MULTICHIP_r*.json",
@@ -171,6 +201,7 @@ ROUND_ARTIFACT_PATTERNS = (
     "BASELINE.json",
     "PAPERS.md",
     "SNIPPETS.md",
+    MANIFEST_NAME,
 )
 
 
@@ -303,9 +334,11 @@ def count_entries(reference: pathlib.Path, scan_result: dict = None):
     can never disagree about whether the same mount is empty. A caller
     that already ran bench.scan() (bench.main embedding verification)
     passes its result via scan_result so the counting walk is not
-    repeated. (A non-empty observation still triggers a separate
-    traversal for the manifest — see write_manifest, which derives its
-    entry_count from its own walk for exactly that reason.)
+    repeated. (A non-empty observation still triggers ONE separate
+    traversal: verify() calls build_manifest, classifies the shape from
+    those entries, and hands the same entries to write_manifest — so
+    the manifest's entry_count reflects that later walk, not this
+    count, which may differ if the mount changed in between.)
     """
     result = scan_result if scan_result is not None else bench.scan(reference)
     metric = result["metric"]
@@ -479,10 +512,42 @@ def build_manifest(reference: pathlib.Path) -> list:
     return entries
 
 
-def write_manifest(reference: pathlib.Path, repo: pathlib.Path) -> str:
-    """Write the manifest; its entry_count is derived from its own walk
-    (the mount may have changed between the counting walk and this one,
-    so the evidence file must be internally consistent).
+def classify_manifest_shape(entries: list) -> str:
+    """"vcs-metadata-only" when EVERY observed entry is git version-
+    control metadata; "working-tree" otherwise.
+
+    Two layouts count as VCS-only: a tree whose single top-level entry
+    is `.git` (the shape BASELINE.json predicts for the upstream), and
+    a tree that IS a bare git directory (top-level names a subset of
+    the bare-repo anatomy, with the load-bearing HEAD/objects/refs all
+    present). Detection is deliberately strict — any non-git top-level
+    entry means working files exist and the normal read order applies.
+    The distinction is verdict-critical: in a VCS-only tree the real
+    source lives in the object store, so "no README, no entry points"
+    is evidence about the PACKAGING, not the capabilities, and the
+    playbook must materialize the committed tree before concluding
+    anything (SURVEY_REWRITE.md)."""
+    top = {entry["path"].split("/", 1)[0] for entry in entries}
+    if top == {".git"}:
+        return MANIFEST_SHAPE_VCS_ONLY
+    if {"HEAD", "objects", "refs"} <= top and top <= BARE_GIT_DIR_NAMES:
+        return MANIFEST_SHAPE_VCS_ONLY
+    return MANIFEST_SHAPE_WORKING_TREE
+
+
+def write_manifest(reference: pathlib.Path, repo: pathlib.Path, entries: list = None):
+    """Write the manifest; returns (path_str, shape). The entry_count
+    is derived from the entries list actually recorded — by default its
+    own fresh walk, or the caller's walk via `entries` (verify() walks
+    once, classifies the shape from that walk, then passes the same
+    entries here, so the shape it reports and the manifest it writes
+    can never describe two different trees — and the shape survives
+    even when the WRITE fails: the classification is evidence from the
+    walk, not a property of repo-dir writability). Either way the
+    recorded count matches the recorded entries, never the earlier
+    counting walk (the mount may have changed in between). The shape
+    classification is embedded in the payload so the evidence file
+    self-describes.
 
     Written atomically (per-process unique temp file + os.replace):
     concurrent gate runs (e.g. bench and verify_reference in the same
@@ -504,7 +569,9 @@ def write_manifest(reference: pathlib.Path, repo: pathlib.Path) -> str:
                 pass
     except OSError:
         pass
-    entries = build_manifest(reference)
+    if entries is None:
+        entries = build_manifest(reference)
+    shape = classify_manifest_shape(entries)
     payload = {
         "comment": (
             "A NON-EMPTY reference tree was observed. SURVEY.md (which "
@@ -512,8 +579,16 @@ def write_manifest(reference: pathlib.Path, repo: pathlib.Path) -> str:
             "from this real tree before any build work; this manifest is "
             "the evidence to start that rewrite from. Only the mounted "
             "tree defines capabilities."
+            + (
+                " SHAPE WARNING: every entry is git version-control "
+                "metadata — materialize the committed tree before "
+                "surveying (SURVEY_REWRITE.md, 'The bare-git shape')."
+                if shape == MANIFEST_SHAPE_VCS_ONLY
+                else ""
+            )
         ),
         "reference_path": str(reference),
+        "shape": shape,
         "entry_count": len(entries),
         "entries": entries,
     }
@@ -532,7 +607,7 @@ def write_manifest(reference: pathlib.Path, repo: pathlib.Path) -> str:
         except OSError:
             pass
         raise
-    return str(manifest_path)
+    return str(manifest_path), shape
 
 
 def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None):
@@ -617,11 +692,23 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
 
     manifest = None
     manifest_error = None
+    manifest_shape = None
     if isinstance(count, int) and count > 0:
+        # Walk and classify FIRST, write second: the shape is evidence
+        # from the walk, and the verdict-critical VCS-only warning must
+        # survive a read-only repo dir or full disk — only a failure of
+        # the walk itself (OSError from build_manifest) leaves the
+        # shape genuinely unknowable.
         try:
-            manifest = write_manifest(reference, repo)
+            entries = build_manifest(reference)
         except OSError as exc:
             manifest_error = bench.exc_detail(exc)
+        else:
+            manifest_shape = classify_manifest_shape(entries)
+            try:
+                manifest, _shape = write_manifest(reference, repo, entries)
+            except OSError as exc:
+                manifest_error = bench.exc_detail(exc)
 
     # Transient observations (unscannable mount, unreadable sidecar)
     # always mismatch the fingerprint — the fingerprint never stores a
@@ -708,6 +795,22 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
                 + "."
             )
 
+    if manifest_shape == MANIFEST_SHAPE_VCS_ONLY:
+        # Reachable from both non-empty paths (rc 1 drift and rc 0
+        # after a deliberate re-pin): whichever way a VCS-only tree was
+        # observed, the warning must ride along — the read order for
+        # working files finds nothing in such a tree, and "found
+        # nothing" must not be mistaken for "no capabilities".
+        note += (
+            " NOTE: every observed entry is git VERSION-CONTROL METADATA "
+            "(a bare or hidden .git tree with no working files). The real "
+            "source, if any, lives in the git object store — do NOT "
+            "conclude 'no capabilities' from the absence of working "
+            "files; materialize the committed tree read-only (git clone "
+            "from the mount) and survey THAT (SURVEY_REWRITE.md, 'The "
+            "bare-git shape')."
+        )
+
     result = {
         "check": "reference_verification",
         "reference_path": str(reference),
@@ -725,6 +828,8 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
         result["sidecar_errors"] = sidecar_errors
     if manifest_error is not None:
         result["manifest_error"] = manifest_error
+    if manifest_shape is not None:
+        result["manifest_shape"] = manifest_shape
     if mount_type_error is not None:
         result["mount_type_error"] = mount_type_error
     return result, exit_code
