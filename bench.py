@@ -209,35 +209,37 @@ def main() -> int:
         repo = pathlib.Path(os.environ.get("GRAFT_REPO_PATH", _REPO_DIR))
         result = scan(reference)
         result["verification"] = verification_summary(reference, repo, result)
-        print(json.dumps(result))
-        return 0
+        line = json.dumps(result)
     except Exception as exc:  # noqa: BLE001 — the driver contract outranks
         # scan() guards OSError and verification_summary guards itself,
         # but anything escaping here would exit rc 1 with a traceback and
         # ZERO JSON lines — breaking the very contract this module exists
-        # to uphold. The print and the serialization sit INSIDE the try
-        # (a result json.dumps cannot serialize, or a failing stdout,
-        # are crashes like any other), and the fallback line is built
-        # from literals so it cannot fail the same way. The crash stays
-        # visible (never reported as an empty tree); the contract stays
-        # intact.
-        try:
-            failure = {
-                "metric": "bench_internal_error",
-                "value": -1,
-                "unit": "reference_entries",
-                "vs_baseline": None,
-                "error": exc_detail(exc),
-            }
-            print(json.dumps(failure))
-            return 0
-        except Exception:  # noqa: BLE001 — stdout itself is broken
-            # Even the literal fallback could not be printed: stdout is
-            # unwritable, so NO JSON line is physically possible and the
-            # one-line/rc-0 contract cannot be met. Exit nonzero so the
-            # empty output reads as the failure it is — a silent rc 0
-            # with no line would be a fake success.
-            return 1  # no JSON line was possible
+        # to uphold. Serialization sits INSIDE the try (a result
+        # json.dumps cannot serialize is a crash like any other); the
+        # fallback dict is literal-typed strings/ints/None — with
+        # exc_detail guaranteed not to raise, its json.dumps cannot
+        # fail. The crash stays visible (never reported as an empty
+        # tree); the contract stays intact.
+        failure = {
+            "metric": "bench_internal_error",
+            "value": -1,
+            "unit": "reference_entries",
+            "vs_baseline": None,
+            "error": exc_detail(exc),
+        }
+        line = json.dumps(failure)
+    # Exactly ONE write attempt, of a fully serialized line. If it
+    # raises, stdout may already hold a PARTIAL line — attempting a
+    # second print there would concatenate onto the fragment and exit 0
+    # with one unparseable line (a masquerade worse than silence). So
+    # once a write has been attempted and failed, nothing more is
+    # written: no JSON line is possible, and bench exits nonzero so the
+    # mangled/empty output reads as the failure it is.
+    try:
+        print(line)
+        return 0
+    except Exception:  # noqa: BLE001 — stdout itself is broken
+        return 1  # no JSON line was possible
 
 
 if __name__ == "__main__":

@@ -135,15 +135,15 @@ MUTATIONS = (
     (
         "bench-breaks-one-line-contract",
         "bench.py",
-        '        print(json.dumps(result))\n        return 0',
-        '        print(json.dumps(result))\n        print("extra")\n        return 0',
+        '        print(line)\n        return 0',
+        '        print(line)\n        print("extra")\n        return 0',
         "bench must print exactly one JSON line (driver contract)",
     ),
     (
         "bench-print-failure-reads-as-success",
         "bench.py",
-        '            return 1  # no JSON line was possible',
-        '            return 0  # no JSON line was possible',
+        '        return 1  # no JSON line was possible',
+        '        return 0  # no JSON line was possible',
         "when stdout is unwritable and no JSON line can exist, bench must not "
         "exit 0 — an empty rc-0 output would be a fake success",
     ),
@@ -175,8 +175,8 @@ MUTATIONS = (
     (
         "bench-crash-masquerades-as-empty",
         "bench.py",
-        '                "metric": "bench_internal_error",\n                "value": -1,',
-        '                "metric": "non_graftable_reference_is_empty",\n                "value": 0,',
+        '            "metric": "bench_internal_error",\n            "value": -1,',
+        '            "metric": "non_graftable_reference_is_empty",\n            "value": 0,',
         "a bench crash must degrade to a visible error metric, never an authoritative empty-tree report",
     ),
 )

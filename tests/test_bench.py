@@ -282,6 +282,29 @@ def test_broken_stdout_exits_nonzero_never_silent_success(
     assert bench.main() == 1
 
 
+def test_failed_write_never_appends_to_a_partial_line(
+    tmp_path, fake_repo, monkeypatch
+):
+    """Once a write of the result line has been attempted and failed,
+    stdout may hold a PARTIAL line — bench must not write anything
+    more (a fallback appended to the fragment would exit 0 with one
+    unparseable line, a masquerade worse than silence)."""
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(tmp_path / "ref"))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(fake_repo))
+    writes = []
+
+    def bursting_write(s):
+        writes.append(s)  # the fragment "reached" the pipe...
+        raise OSError(32, "Broken pipe")  # ...then the write failed
+
+    monkeypatch.setattr(sys.stdout, "write", bursting_write)
+    assert bench.main() == 1
+    # print(line) attempts write(line) first and dies there; the
+    # trailing-newline write and any fallback must never follow.
+    assert len(writes) == 1
+    assert writes[0].startswith('{"metric"')
+
+
 def test_exception_with_raising_str_still_degrades_cleanly(
     tmp_path, fake_repo, monkeypatch, capsys
 ):

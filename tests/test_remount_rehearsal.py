@@ -1,0 +1,209 @@
+"""End-to-end rehearsal of the remount playbook (SURVEY_REWRITE.md).
+
+The playbook is the procedure a fresh session executes on the repo's
+highest-stakes day — the day the reference mount stops being empty.
+Until round 5 it had only ever been *written*, never *executed*; its
+first real execution should not also be its first test. These tests
+walk steps 0-3 mechanically, over both predicted remount shapes:
+
+- a plain working tree (README/src/... — the shape the playbook's
+  normal read order serves), and
+- the bare-git shape BASELINE.json actually predicts ("only a bare
+  .git directory"), including the materialization command the playbook
+  §0b prescribes, run against a READ-ONLY mount exactly like the real
+  one (mode dr-xr-xr-x).
+
+Each numbered assertion block cites the playbook step it rehearses.
+The tests use a real temp git repo for the fake repo dir so the
+hygiene field (commit-the-manifest-first, step 0.4) is exercised for
+real, and a real `git clone` for materialization so the committed
+command is proven to work from a read-only source.
+"""
+
+import hashlib
+import json
+import os
+import pathlib
+import subprocess
+
+from conftest import make_fake_repo
+
+import verify_reference
+
+
+def run_gate(monkeypatch, capsys, reference, repo):
+    """In-process ``python verify_reference.py`` (same as the suite's
+    other in-process runs; the true-subprocess contract is covered by
+    the e2e fixture tests)."""
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(reference))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(repo))
+    monkeypatch.setenv(
+        "GIT_CEILING_DIRECTORIES", str(pathlib.Path(repo).parent)
+    )
+    rc = verify_reference.main()
+    out = capsys.readouterr().out.splitlines()
+    assert len(out) == 1  # the one-line stdout contract holds throughout
+    return rc, json.loads(out[0])
+
+
+def git(cwd, *args):
+    proc = subprocess.run(
+        [
+            "git",
+            "-C",
+            str(cwd),
+            "-c",
+            "user.email=rehearsal@example.com",
+            "-c",
+            "user.name=rehearsal",
+            *args,
+        ],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, (args, proc.stderr)
+    return proc.stdout
+
+
+def repin_fingerprint(repo, count, why):
+    """Playbook step 3: deliberate fingerprint re-pin, count + comment."""
+    path = repo / "reference_fingerprint.json"
+    fingerprint = json.loads(path.read_text())
+    fingerprint["reference_entry_count"] = count
+    fingerprint["comment"] = why
+    path.write_text(json.dumps(fingerprint))
+
+
+def chmod_read_only(root):
+    """Approximate the real mount's dr-xr-xr-x: dirs 0o555, files 0o444."""
+    for dirpath, dirnames, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            os.chmod(pathlib.Path(dirpath) / name, 0o444)
+        os.chmod(dirpath, 0o555)
+
+
+def chmod_writable_again(root):
+    for dirpath, dirnames, filenames in os.walk(root, topdown=False):
+        for name in filenames:
+            os.chmod(pathlib.Path(dirpath) / name, 0o644)
+        os.chmod(dirpath, 0o755)
+
+
+def test_rehearsal_plain_working_tree(tmp_path, monkeypatch, capsys):
+    # A plain working-tree remount: top-level build file, source, docs.
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+    (ref / "src" / "train.py").write_text("def train():\n    return 1\n")
+    (ref / "README.md").write_text("# the real reference\n")
+    (ref / "setup.py").write_text("from setuptools import setup\nsetup()\n")
+    repo = make_fake_repo(tmp_path)
+    git(repo, "init", "-q")
+    git(repo, "add", "-A")
+    git(repo, "commit", "-q", "-m", "round baseline")
+
+    # Step 0.1: the gate observes the event — rc 1, integer count > 0.
+    rc, result = run_gate(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    count = result["observed"]["reference_entry_count"]
+    assert isinstance(count, int) and count == 4
+
+    # Step 0.2: independent confirmation — the gate and a direct walk
+    # of the live tree must agree.
+    independent = sum(len(d) + len(f) for _, d, f in os.walk(ref))
+    assert independent == count
+
+    # Step 0.3: manifest spot-check — hash a couple of regular files
+    # straight off the live tree and compare; no error entries.
+    manifest = json.loads(pathlib.Path(result["manifest"]).read_text())
+    assert manifest["entry_count"] == count
+    assert manifest["shape"] == "working-tree"
+    by_path = {e["path"]: e for e in manifest["entries"]}
+    assert not [e for e in manifest["entries"] if e["type"] == "error"]
+    for rel in ("README.md", "src/train.py"):
+        live = hashlib.sha256((ref / rel).read_bytes()).hexdigest()
+        assert by_path[rel]["sha256"] == live, rel
+
+    # Step 0.4: the hygiene field demands the manifest be committed
+    # before anything else; committing it satisfies the check.
+    assert result["uncommitted_round_artifacts"] == [
+        verify_reference.MANIFEST_NAME
+    ]
+    git(repo, "add", verify_reference.MANIFEST_NAME)
+    git(repo, "commit", "-q", "-m", "record observed manifest (step 0.4)")
+
+    # Step 3: deliberate re-pin; the gate must return to rc 0 with the
+    # non-empty note — NOT the emptiness claim.
+    repin_fingerprint(repo, count, "rehearsal: plain-tree remount observed")
+    rc, result = run_gate(monkeypatch, capsys, ref, repo)
+    assert rc == verify_reference.EXIT_MATCH
+    assert "NON-EMPTY" in result["note"]
+    assert "non-graftable verdict no longer applies" in result["note"]
+    assert "still empty" not in result["note"]
+    assert result["uncommitted_round_artifacts"] == []
+
+
+def test_rehearsal_bare_git_shape(tmp_path, monkeypatch, capsys):
+    # Build a real upstream history, then package it the way
+    # BASELINE.json predicts: a mount containing ONLY .git.
+    upstream = tmp_path / "upstream"
+    (upstream / "src").mkdir(parents=True)
+    (upstream / "src" / "model.py").write_text("LAYERS = 12\n")
+    (upstream / "README.md").write_text("# hidden in the object store\n")
+    git(upstream, "init", "-q")
+    git(upstream, "add", "-A")
+    git(upstream, "commit", "-q", "-m", "the real source")
+    head = git(upstream, "rev-parse", "HEAD").strip()
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (upstream / ".git").rename(ref / ".git")
+    chmod_read_only(ref)  # the real mount is dr-xr-xr-x
+    try:
+        repo = make_fake_repo(tmp_path)
+        git(repo, "init", "-q")
+        git(repo, "add", "-A")
+        git(repo, "commit", "-q", "-m", "round baseline")
+
+        # Step 0 + §0b detection: rc 1, and the gate says VCS-only —
+        # the working-file read order must NOT be trusted here.
+        rc, result = run_gate(monkeypatch, capsys, ref, repo)
+        assert rc == verify_reference.EXIT_DRIFT
+        count = result["observed"]["reference_entry_count"]
+        assert isinstance(count, int) and count > 0
+        assert result["manifest_shape"] == "vcs-metadata-only"
+        assert "VERSION-CONTROL METADATA" in result["note"]
+        assert "materialize" in result["note"]
+
+        # Step 0.4 before reading further.
+        git(repo, "add", verify_reference.MANIFEST_NAME)
+        git(repo, "commit", "-q", "-m", "record observed manifest")
+
+        # §0b.2: materialize the committed tree READ-ONLY — the exact
+        # command the playbook commits to, run against the read-only
+        # mount (clone only reads the source).
+        dest = tmp_path / "ref_materialized"
+        git(tmp_path, "clone", "-q", str(ref), str(dest))
+        assert (dest / "README.md").read_text() == "# hidden in the object store\n"
+        assert (dest / "src" / "model.py").read_text() == "LAYERS = 12\n"
+
+        # §0b.3: pin the surveyed revision — the materialized HEAD is
+        # exactly the upstream commit, and ls-tree inventories it.
+        assert git(dest, "rev-parse", "HEAD").strip() == head
+        listing = git(dest, "ls-tree", "-r", "--long", "HEAD")
+        assert "README.md" in listing and "src/model.py" in listing
+
+        # The mount stayed pristine through materialization: the gate
+        # re-observes the identical count.
+        rc2, result2 = run_gate(monkeypatch, capsys, ref, repo)
+        assert result2["observed"]["reference_entry_count"] == count
+
+        # Step 3: re-pin; rc 0 must KEEP the VCS-only warning — a match
+        # is not permission to survey metadata as if it were source.
+        repin_fingerprint(repo, count, "rehearsal: bare-git remount observed")
+        rc, result = run_gate(monkeypatch, capsys, ref, repo)
+        assert rc == verify_reference.EXIT_MATCH
+        assert "NON-EMPTY" in result["note"]
+        assert result["manifest_shape"] == "vcs-metadata-only"
+        assert "VERSION-CONTROL METADATA" in result["note"]
+    finally:
+        chmod_writable_again(ref)

@@ -161,6 +161,11 @@ def test_bare_git_layout_is_flagged_vcs_metadata_only(
     (ref / "HEAD").write_text("ref: refs/heads/main\n")
     (ref / "config").write_text("[core]\n\tbare = true\n")
     (ref / "packed-refs").write_text("# pack-refs\n")
+    # git-generated residue must not defeat the detection: a failed gc
+    # or an lfs cache at top level is still a bare repo, not a source
+    # tree.
+    (ref / "gc.log").write_text("warning: There are too many loose objects\n")
+    (ref / "lfs").mkdir()
     rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
     assert rc == verify_reference.EXIT_DRIFT
     assert result["manifest_shape"] == "vcs-metadata-only"
@@ -919,7 +924,7 @@ def test_scan_count_and_manifest_agree(tmp_path):
         repo.mkdir()
         scanned = bench.scan(tree)["value"]
         assert len(verify_reference.build_manifest(tree)) == scanned, tree
-        manifest_path, _shape = verify_reference.write_manifest(tree, repo)
+        manifest_path = verify_reference.write_manifest(tree, repo)
         written = json.loads(pathlib.Path(manifest_path).read_text())
         assert written["entry_count"] == scanned, tree
 

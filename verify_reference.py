@@ -178,6 +178,12 @@ BARE_GIT_DIR_NAMES = frozenset((
     "COMMIT_EDITMSG", "config", "description", "hooks", "info",
     "objects", "refs", "packed-refs", "branches", "logs", "index",
     "shallow", "worktrees", "modules",
+    # git-generated residue commonly left at a repo dir's top level —
+    # without these a single stray gc.log would flip a bare repo to
+    # "working-tree" and suppress the materialize warning.
+    "gc.log", "gc.pid", "lfs", "sequencer", "rebase-merge",
+    "rebase-apply", "CHERRY_PICK_HEAD", "REVERT_HEAD", "BISECT_LOG",
+    "BISECT_START", "BISECT_EXPECTED_REV", "AUTO_MERGE",
 ))
 # Orphaned manifest temp files older than this are swept; younger ones
 # may belong to a concurrent run mid-write and must be left alone.
@@ -535,12 +541,17 @@ def classify_manifest_shape(entries: list) -> str:
     return MANIFEST_SHAPE_WORKING_TREE
 
 
-def write_manifest(reference: pathlib.Path, repo: pathlib.Path, entries: list = None):
-    """Write the manifest; returns (path_str, shape). The entry_count
-    is derived from the entries list actually recorded — by default its
-    own fresh walk, or the caller's walk via `entries` (verify() walks
-    once, classifies the shape from that walk, then passes the same
-    entries here, so the shape it reports and the manifest it writes
+def write_manifest(
+    reference: pathlib.Path,
+    repo: pathlib.Path,
+    entries: list = None,
+    shape: str = None,
+):
+    """Write the manifest; returns its path. The entry_count is derived
+    from the entries list actually recorded — by default its own fresh
+    walk, or the caller's walk via `entries` (verify() walks once,
+    classifies the shape from that walk, then passes the same entries
+    AND shape here, so the shape it reports and the manifest it writes
     can never describe two different trees — and the shape survives
     even when the WRITE fails: the classification is evidence from the
     walk, not a property of repo-dir writability). Either way the
@@ -571,7 +582,8 @@ def write_manifest(reference: pathlib.Path, repo: pathlib.Path, entries: list = 
         pass
     if entries is None:
         entries = build_manifest(reference)
-    shape = classify_manifest_shape(entries)
+    if shape is None:
+        shape = classify_manifest_shape(entries)
     payload = {
         "comment": (
             "A NON-EMPTY reference tree was observed. SURVEY.md (which "
@@ -607,7 +619,7 @@ def write_manifest(reference: pathlib.Path, repo: pathlib.Path, entries: list = 
         except OSError:
             pass
         raise
-    return str(manifest_path), shape
+    return str(manifest_path)
 
 
 def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None):
@@ -706,7 +718,9 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
         else:
             manifest_shape = classify_manifest_shape(entries)
             try:
-                manifest, _shape = write_manifest(reference, repo, entries)
+                manifest = write_manifest(
+                    reference, repo, entries, manifest_shape
+                )
             except OSError as exc:
                 manifest_error = bench.exc_detail(exc)
 
