@@ -165,6 +165,40 @@ MUTATIONS = (
         "(rc 1, type named), never a transient 're-run and it'll clear' (rc 3)",
     ),
     (
+        "manifest-escapes-hygiene-check",
+        "verify_reference.py",
+        '    "SNIPPETS.md",\n    MANIFEST_NAME,\n)',
+        '    "SNIPPETS.md",\n)',
+        "the gate-written remount manifest must be covered by the uncommitted-"
+        "artifact check — remount day is the hygiene backstop's highest-stakes day",
+    ),
+    (
+        "vcs-warning-dropped-on-write-failure",
+        "verify_reference.py",
+        '        else:\n'
+        '            manifest_shape = classify_manifest_shape(entries)\n'
+        '            try:\n'
+        '                manifest = write_manifest(\n'
+        '                    reference, repo, entries, manifest_shape\n'
+        '                )',
+        '        else:\n'
+        '            try:\n'
+        '                manifest = write_manifest(\n'
+        '                    reference, repo, entries\n'
+        '                )\n'
+        '                manifest_shape = classify_manifest_shape(entries)',
+        "the VCS-only materialize warning is evidence from the walk and must "
+        "survive a failed manifest write (read-only repo dir / full disk)",
+    ),
+    (
+        "mount-absence-escalates-to-drift",
+        "verify_reference.py",
+        '    except FileNotFoundError:\n        return MOUNT_ABSENT, None',
+        '    except FileNotFoundError:\n        return MOUNT_NOT_A_DIR, "path absent"',
+        "an absent mount (driver not ready yet) must stay transient rc 3, never "
+        "escalate to wrong-type drift rc 1",
+    ),
+    (
         "bare-git-tree-reads-as-working-source",
         "verify_reference.py",
         '    top = {entry["path"].split("/", 1)[0] for entry in entries}',

@@ -338,6 +338,27 @@ def test_exception_with_raising_str_still_degrades_cleanly(
     assert result["error"].startswith("EvilError")
 
 
+def test_verification_summary_reinserts_repo_dir_into_sys_path(
+    tmp_path, fake_repo, monkeypatch
+):
+    """The lazy-import arm that restores the repo dir to sys.path —
+    needed when bench runs as a script from a foreign cwd and nothing
+    else has made verify_reference importable."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    scan_result = bench.scan(ref)
+    monkeypatch.setattr(
+        sys, "path", [p for p in sys.path if p != str(bench._REPO_DIR)]
+    )
+    # Drop the cached module too, so the lazy import genuinely resolves
+    # through the inserted path instead of a sys.modules cache hit —
+    # otherwise a broken insert would go unnoticed.
+    monkeypatch.delitem(sys.modules, "verify_reference", raising=False)
+    summary = bench.verification_summary(ref, fake_repo, scan_result)
+    assert str(bench._REPO_DIR) in sys.path
+    assert summary["exit_code"] == verify_reference.EXIT_MATCH
+
+
 def test_fingerprint_corrupt_surfaces_in_verification(
     tmp_path, fake_repo, monkeypatch, capsys
 ):

@@ -512,6 +512,63 @@ def test_mount_healthy_again_by_observation_time_stays_transient(
     assert "mount_type_error" not in result
 
 
+def test_mount_fstat_failure_is_unreadable_not_drift(tmp_path, monkeypatch):
+    """The post-open fstat arm of the mount observation: a read failure
+    AFTER a successful open leaves the type unknown — unreadable
+    (transient), never wrong-type drift."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+
+    def broken_fstat(fd):
+        raise OSError(5, "Input/output error")
+
+    monkeypatch.setattr(os, "fstat", broken_fstat)
+    state, detail = verify_reference.observe_mount_type(ref)
+    assert state == verify_reference.MOUNT_UNREADABLE
+    assert detail == "OSError: [Errno 5] Input/output error"
+
+
+def test_manifest_walk_failure_leaves_shape_unknown_but_reports_drift(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """If the manifest's own traversal dies (distinct from the counting
+    walk, which succeeded), the gate still reports drift rc 1 with
+    manifest_error — and no shape claim, because only a walk can
+    classify a shape."""
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+
+    def walk_died(reference):
+        raise OSError(116, "Stale file handle")
+
+    monkeypatch.setattr(verify_reference, "build_manifest", walk_died)
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest"] is None
+    assert result["manifest_error"] == "OSError: [Errno 116] Stale file handle"
+    assert "manifest_shape" not in result
+    assert "VERSION-CONTROL METADATA" not in result["note"]
+
+
+def test_sweep_glob_failure_does_not_block_manifest_write(tmp_path, monkeypatch):
+    """The stale-tmp sweep is best-effort at BOTH levels: repo.glob
+    itself raising (not just a per-file stat/unlink) must not stop the
+    manifest from being written."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / "f").write_text("x\n")
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def broken_glob(self, pattern):
+        raise OSError("glob exploded")
+
+    monkeypatch.setattr(pathlib.Path, "glob", broken_glob)
+    manifest_path = verify_reference.write_manifest(ref, repo)
+    written = json.loads(pathlib.Path(manifest_path).read_text())
+    assert written["entry_count"] == 1
+
+
 def test_changed_baseline_sidecar_is_drift_exits_1(
     tmp_path, fake_repo, monkeypatch, capsys
 ):
