@@ -46,8 +46,12 @@ def run_gate(monkeypatch, capsys, reference, repo):
     return rc, json.loads(out[0])
 
 
-def git(cwd, *args):
-    proc = subprocess.run(
+def git_raw(cwd, *args):
+    # LC_ALL=C: the commit-less rehearsal asserts on git's message
+    # text, which localizes under non-English locales with gettext
+    # catalogs installed.
+    env = dict(os.environ, LC_ALL="C")
+    return subprocess.run(
         [
             "git",
             "-C",
@@ -60,7 +64,12 @@ def git(cwd, *args):
         ],
         capture_output=True,
         text=True,
+        env=env,
     )
+
+
+def git(cwd, *args):
+    proc = git_raw(cwd, *args)
     assert proc.returncode == 0, (args, proc.stderr)
     return proc.stdout
 
@@ -205,5 +214,54 @@ def test_rehearsal_bare_git_shape(tmp_path, monkeypatch, capsys):
         assert "NON-EMPTY" in result["note"]
         assert result["manifest_shape"] == "vcs-metadata-only"
         assert "VERSION-CONTROL METADATA" in result["note"]
+    finally:
+        chmod_writable_again(ref)
+
+
+def test_rehearsal_commitless_git_records_negative_result(
+    tmp_path, monkeypatch, capsys
+):
+    """Playbook §0b's fallback branch: a .git with NO commits — the
+    closest match to BASELINE.json's description of the upstream. The
+    clone of a commit-less repository SUCCEEDS (with a warning) and
+    yields an empty working tree, so the playbook's readable-HEAD check
+    is the step that must catch it: the failing command output — not
+    the absence of working files — is the evidence that the object
+    store defines no capabilities."""
+    upstream = tmp_path / "upstream"
+    upstream.mkdir()
+    git(upstream, "init", "-q")  # no commits ever made
+
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (upstream / ".git").rename(ref / ".git")
+    chmod_read_only(ref)
+    try:
+        repo = make_fake_repo(tmp_path)
+
+        # The gate still classifies the shape and demands materialization
+        # — detection cannot know whether the store holds commits.
+        rc, result = run_gate(monkeypatch, capsys, ref, repo)
+        assert rc == verify_reference.EXIT_DRIFT
+        assert result["manifest_shape"] == "vcs-metadata-only"
+
+        # §0b.2: the clone itself succeeds...
+        dest = tmp_path / "ref_materialized"
+        clone = git_raw(tmp_path, "clone", "-q", str(ref), str(dest))
+        assert clone.returncode == 0
+        assert "empty repository" in (clone.stderr + clone.stdout)
+        # ...with no working files — which alone proves NOTHING...
+        assert not [p for p in dest.iterdir() if p.name != ".git"]
+        # ...and the readable-HEAD check is what produces the recordable
+        # negative evidence.
+        head = git_raw(dest, "log", "-1")
+        assert head.returncode != 0
+        assert "does not have any commits" in head.stderr
+        # The same probe works directly against the read-only mount —
+        # and fails for the RIGHT reason (no revision behind HEAD), not
+        # a path/permission mistake.
+        direct = git_raw(tmp_path, "--git-dir", str(ref / ".git"), "rev-parse", "HEAD")
+        assert direct.returncode != 0
+        assert "unknown revision" in direct.stderr
     finally:
         chmod_writable_again(ref)
