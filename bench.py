@@ -46,8 +46,12 @@ command the driver provably runs every round: reference remounts and
 sidecar drift (PAPERS.md/SNIPPETS.md/BASELINE.json changing) land in
 BENCH_r*.json automatically, with no human in the loop. The summary
 carries the gate's human-facing ``note`` so the artifact self-describes
-without the SKILL.md exit-code table, and surfaces uncommitted driver
-round artifacts when the hygiene check finds any. The embedding is
+without the SKILL.md exit-code table, and passes through the gate's
+optional evidence fields when present: the remount manifest path and
+its ``manifest_shape`` (so a VCS-metadata-only remount can never look
+like a plain source tree in a driver artifact), ``mount_type_error``
+(a non-directory mount names its type), ``sidecar_errors``, and the
+uncommitted round artifacts the hygiene check finds. The embedding is
 best-effort: any failure inside verification degrades to an ``error``
 field and can never break the one-line / rc-0 contract.
 

@@ -33,6 +33,25 @@ def test_every_mutation_pattern_matches_live_source_exactly_once():
         assert old != new, f"mutation {name!r} is a no-op"
 
 
+def test_docs_cite_the_live_mutant_count():
+    """The mutant count appears in PRESENT-TENSE prose (README, the
+    verify skill) that must track the live MUTATIONS tuple forever —
+    and it has drifted under growth three times already (one advisor
+    finding, two review findings). Enforce the sync mechanically:
+    growing the audit without updating the docs turns the suite red in
+    the same commit. Per-round history lines ("suite N passed" in old
+    round records) are deliberately NOT enforced — history is frozen;
+    only present-tense claims must track the code."""
+    n = len(mutation_audit.MUTATIONS)
+    readme = (mutation_audit.REPO / "README.md").read_text()
+    assert f"{n} targeted mutants" in readme
+    assert f"{n}/{n} killed" in readme
+    skill = (
+        mutation_audit.REPO / ".claude" / "skills" / "verify" / "SKILL.md"
+    ).read_text()
+    assert f"current {n} mutants" in skill
+
+
 def test_mutations_cover_both_runtime_surfaces():
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {"bench.py", "verify_reference.py"}
