@@ -1,0 +1,40 @@
+"""arena — JAX-native pairwise-comparison rating engine.
+
+The first real compute subsystem of this repo (forward-building per
+ROADMAP.md; the empty upstream reference defines nothing to reproduce —
+see README.md "Arena engine" for the honesty framing).
+
+Modules:
+- `arena.ratings`  — pure vectorized math: batched online Elo,
+  Bradley–Terry MLE, the scatter-free sorted segment sum.
+- `arena.engine`   — ingestion (CSR-style grouping), shape-bucketed
+  batching, the stateful `ArenaEngine` with jitted donated updates.
+- `arena.sharding` — device mesh, partition-rule matching, shard_map
+  data-parallel updates (CPU-mesh testable, no TPU required).
+- `arena.baseline` — the deliberately naive loop implementation the
+  bench measures against.
+- `arena.bench_arena` — the one-JSON-line benchmark entrypoint.
+"""
+
+from arena.engine import ArenaEngine, bucket_size, pack_batch, pack_epoch
+from arena.ratings import (
+    bt_fit,
+    elo_batch_update,
+    elo_batch_update_sorted,
+    elo_epoch,
+    elo_expected,
+    sorted_segment_sum,
+)
+
+__all__ = [
+    "ArenaEngine",
+    "bucket_size",
+    "pack_batch",
+    "pack_epoch",
+    "bt_fit",
+    "elo_batch_update",
+    "elo_batch_update_sorted",
+    "elo_epoch",
+    "elo_expected",
+    "sorted_segment_sum",
+]

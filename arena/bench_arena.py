@@ -1,0 +1,239 @@
+"""Benchmark: naive-loop vs vectorized/jitted arena rating updates.
+
+The repo's first real performance number. Emits the same one-JSON-line
+rc-0 contract `bench.py` honors (one line on stdout no matter what;
+internal failures degrade to a distinct error metric; only an
+unwritable stdout exits 1), so the driver can record it the same way.
+
+What is measured (all on synthetic matches from a seeded
+Bradley–Terry ground truth, so the workload is reproducible):
+
+- ``naive_epoch_s`` — one full pass of batched Elo over the match set
+  via `arena/baseline.py`'s per-match Python/NumPy loop.
+- ``jit_epoch_s`` — the same pass (same batch semantics, same batch
+  size) through the fused, scatter-free jitted epoch
+  (`arena.ratings.elo_epoch`), min over repeats after a warmup call
+  (compile time excluded, steady-state measured).
+- ``ingest_s`` — the one-time NumPy cost of bucketing/grouping the
+  match set (`arena.engine.pack_epoch`). Reported separately and also
+  folded into ``speedup_incl_ingest``: ingest is paid once per
+  dataset, the epoch cost is paid on every pass (Elo refits,
+  bootstrap rounds) and every Bradley–Terry iteration, so the
+  headline ``value`` is the steady-state update speedup.
+- Bradley–Terry: per-MM-iteration time, naive loop vs fused scan.
+- If more than one device is visible (or ARENA_BENCH_DEVICES forces a
+  CPU device count), the shard_map data-parallel epoch is timed too —
+  reported as numbers per device count, with no speedup claim: on this
+  1-core image extra host devices share one core.
+
+The two paths' final ratings are compared BEFORE any speedup is
+reported (``equivalence_ok`` rides in the line; a speedup over code
+computing something different would be fiction).
+
+Env knobs (all optional): ARENA_BENCH_MATCHES (100000),
+ARENA_BENCH_PLAYERS (1000), ARENA_BENCH_BATCH (8192),
+ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED (0), ARENA_BENCH_BT_ITERS
+(25), ARENA_BENCH_DEVICES (unset — forces a host CPU device count for
+the sharded path when the backend is not yet initialized).
+"""
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+# Must precede any JAX computation (backend init reads XLA_FLAGS; the
+# flag is inert after that, which the device-count check below detects).
+_FORCED_DEVICES = os.environ.get("ARENA_BENCH_DEVICES")
+if _FORCED_DEVICES:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={_FORCED_DEVICES}"
+        ).strip()
+
+_REPO_DIR = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO_DIR) not in sys.path:
+    sys.path.insert(0, str(_REPO_DIR))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402  (exc_detail — the repo-wide error formatting)
+from arena import baseline, engine, ratings, sharding  # noqa: E402
+
+# Max |rating diff| tolerated between the naive float64 loop and the
+# float32 scatter-free path, in rating points on the 1500 scale
+# (measured ~2e-4 at the default size; budget leaves room for bigger
+# runs without letting a real divergence through).
+EQUIVALENCE_TOL = 0.5
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def make_matches(num_matches, num_players, seed):
+    """Synthetic outcomes from a Bradley–Terry ground truth: random
+    pairings, winner sampled from true win probability."""
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, num_players, num_matches)
+    b = (a + 1 + rng.integers(0, num_players - 1, num_matches)) % num_players
+    strength = np.linspace(2.0, -2.0, num_players)  # log-strengths
+    p_a_wins = 1.0 / (1.0 + np.exp(strength[b] - strength[a]))
+    a_wins = rng.random(num_matches) < p_a_wins
+    winners = np.where(a_wins, a, b).astype(np.int32)
+    losers = np.where(a_wins, b, a).astype(np.int32)
+    return winners, losers
+
+
+def _best_of(fn, repeats):
+    """Min wall-clock over repeats; fn must block on its result."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_benchmark():
+    num_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
+    num_players = _env_int("ARENA_BENCH_PLAYERS", 1_000)
+    batch = _env_int("ARENA_BENCH_BATCH", 8_192)
+    repeats = _env_int("ARENA_BENCH_REPEATS", 5)
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+    bt_iters = _env_int("ARENA_BENCH_BT_ITERS", 25)
+
+    winners, losers = make_matches(num_matches, num_players, seed)
+
+    # --- naive baseline: full Elo pass, per-match loop ---------------
+    t0 = time.perf_counter()
+    naive_ratings = baseline.elo_epoch_naive(num_players, winners, losers, batch)
+    naive_epoch_s = time.perf_counter() - t0
+
+    # --- ingest (one-time): bucket + group the match set -------------
+    t0 = time.perf_counter()
+    packed = engine.pack_epoch(num_players, winners, losers, batch)
+    jax.block_until_ready(packed.perms)
+    ingest_s = time.perf_counter() - t0
+
+    # --- fused jitted epoch ------------------------------------------
+    epoch_fn = ratings.jit_elo_epoch(num_players, donate=False)
+    r0 = jnp.full((num_players,), ratings.DEFAULT_BASE, jnp.float32)
+    args = (packed.winners, packed.losers, packed.valid, packed.perms, packed.bounds)
+    jit_ratings = epoch_fn(r0, *args)  # warmup: compile excluded
+    jax.block_until_ready(jit_ratings)
+    jit_epoch_s = _best_of(
+        lambda: jax.block_until_ready(epoch_fn(r0, *args)), repeats
+    )
+
+    max_diff = float(np.abs(np.asarray(jit_ratings) - naive_ratings).max())
+    equivalence_ok = max_diff < EQUIVALENCE_TOL
+    speedup = naive_epoch_s / jit_epoch_s
+
+    # --- Bradley–Terry: per-MM-iteration, naive vs fused -------------
+    win_counts = np.bincount(winners, minlength=num_players).astype(np.float64)
+    t0 = time.perf_counter()
+    baseline.bt_mm_step_naive(
+        np.ones(num_players), winners.tolist(), losers.tolist(), win_counts
+    )
+    bt_naive_iter_s = time.perf_counter() - t0
+
+    whole = engine.pack_batch(
+        num_players, winners, losers, min_bucket=engine.bucket_size(num_matches)
+    )
+    wc32 = jnp.asarray(win_counts.astype(np.float32))
+    bt_args = (whole.winners, whole.losers, whole.valid, whole.perm, whole.bounds)
+    bt_fit_fn = ratings.jit_bt_fit(num_players, num_iters=bt_iters)
+
+    def bt_run():
+        return bt_fit_fn(*bt_args, wc32)
+
+    jax.block_until_ready(bt_run())  # warmup
+    bt_jit_iter_s = _best_of(lambda: jax.block_until_ready(bt_run()), repeats) / bt_iters
+
+    # --- sharded path (only meaningful with >1 device) ---------------
+    sharded = None
+    ndev = len(jax.devices())
+    if ndev > 1:
+        mesh = sharding.build_mesh()
+        sharded_fn = sharding.jit_sharded_elo_epoch(mesh)
+        sharded_args = (packed.winners, packed.losers, packed.valid)
+
+        def sharded_run():
+            return jax.block_until_ready(
+                sharded_fn(jnp.full((num_players,), ratings.DEFAULT_BASE), *sharded_args)
+            )
+
+        sharded_run()  # warmup (also compiles)
+        sharded_epoch_s = _best_of(sharded_run, repeats)
+        sharded = {
+            "devices": ndev,
+            "epoch_s": round(sharded_epoch_s, 6),
+            "matches_per_s": round(num_matches / sharded_epoch_s),
+            "note": "CPU host devices share cores; correctness/path proof, not a scaling claim",
+        }
+
+    return {
+        "metric": "arena_elo_update_speedup",
+        "value": round(speedup, 2),
+        "unit": "x_vs_naive_baseline",
+        "vs_baseline": None,
+        "params": {
+            "num_matches": num_matches,
+            "num_players": num_players,
+            "batch_size": batch,
+            "repeats": repeats,
+            "seed": seed,
+        },
+        "elo": {
+            "naive_epoch_s": round(naive_epoch_s, 6),
+            "jit_epoch_s": round(jit_epoch_s, 6),
+            "ingest_s": round(ingest_s, 6),
+            "naive_matches_per_s": round(num_matches / naive_epoch_s),
+            "jit_matches_per_s": round(num_matches / jit_epoch_s),
+            "jit_update_latency_us_per_batch": round(
+                jit_epoch_s / packed.winners.shape[0] * 1e6, 1
+            ),
+            "speedup_incl_ingest": round(naive_epoch_s / (jit_epoch_s + ingest_s), 2),
+        },
+        "bt": {
+            "iters": bt_iters,
+            "naive_iter_s": round(bt_naive_iter_s, 6),
+            "jit_iter_s": round(bt_jit_iter_s, 6),
+            "iter_speedup": round(bt_naive_iter_s / bt_jit_iter_s, 2),
+        },
+        "equivalence_ok": equivalence_ok,
+        "max_rating_diff": round(max_diff, 6),
+        "sharded": sharded,
+    }
+
+
+def main() -> int:
+    try:
+        line = json.dumps(run_benchmark())
+    except Exception as exc:  # noqa: BLE001 — the one-line contract outranks
+        line = json.dumps(
+            {
+                "metric": "arena_bench_internal_error",
+                "value": -1,
+                "unit": "x_vs_naive_baseline",
+                "vs_baseline": None,
+                "error": bench.exc_detail(exc),
+            }
+        )
+    # Same single-write discipline as bench.py: one fully-serialized
+    # line, flush inside the guard, nothing appended after a failure.
+    try:
+        print(line)
+        sys.stdout.flush()
+        return 0
+    except Exception:  # noqa: BLE001 — stdout itself is broken
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,235 @@
+"""Arena rating engine: ingestion, shape-bucketed batching, jitted updates.
+
+The host-side half of the hot path. Three jobs:
+
+1. **Ingest** (`pack_batch` / `pack_epoch`): turn raw match outcome
+   arrays into the device-resident layout the scatter-free update
+   needs — a per-batch permutation grouping the concatenated
+   [winners, losers] indices by player, plus segment boundaries. This
+   is a cheap O(B) NumPy counting sort per batch, computed ONCE per
+   ingested batch; every Elo epoch and every Bradley–Terry iteration
+   over that batch then runs with zero XLA scatters (the CPU scatter
+   is the single most expensive op in the naive-jit formulation — see
+   `arena/ratings.py`).
+
+2. **Shape-bucketed batching** (`bucket_size`): arena traffic arrives
+   in variable-size batches; jitting on raw sizes would recompile per
+   distinct size. Batches are padded up to the next power-of-two
+   bucket (masked with `valid`), so the jit cache holds one executable
+   per BUCKET, not per size — `test_arena_engine.py` asserts zero
+   recompiles across varying sizes via the jit cache stats.
+
+3. **`ArenaEngine`**: the stateful online wrapper — holds the ratings
+   vector, feeds batches through a single jitted update with the
+   ratings buffer donated (XLA reuses the old buffer for the new
+   ratings instead of allocating), and exposes leaderboard reads and
+   batched Bradley–Terry fits over everything ingested so far.
+"""
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arena import ratings as R
+
+# Floor keeps tiny batches from generating one bucket per power of two
+# at the small end where padding is nearly free anyway.
+MIN_BUCKET = 256
+
+
+def bucket_size(n, min_bucket=MIN_BUCKET):
+    """Smallest power-of-two >= n (>= min_bucket). Static per jit cache
+    entry: all batch sizes in (bucket/2, bucket] share one executable."""
+    if n < 0:
+        raise ValueError(f"batch size must be >= 0, got {n}")
+    b = min_bucket
+    while b < n:
+        b *= 2
+    return b
+
+
+class PackedBatch(NamedTuple):
+    """Device-resident, bucket-padded match batch plus its grouping.
+
+    winners/losers/valid: (bucket,) — padded slots have valid == 0 and
+    index 0 (their delta is masked to zero, so the index never matters).
+    perm: (2*bucket,) permutation sorting concat([winners, losers]) by
+    player; bounds: (num_players+1,) segment start offsets in that
+    order. num_real is the unpadded match count (host int).
+    """
+
+    winners: jax.Array
+    losers: jax.Array
+    valid: jax.Array
+    perm: jax.Array
+    bounds: jax.Array
+    num_real: int
+
+
+def _group_by_player(combined, num_players):
+    """Counting-sort grouping of a combined index array (host NumPy)."""
+    order = np.argsort(combined, kind="stable").astype(np.int32)
+    bounds = np.searchsorted(
+        combined[order], np.arange(num_players + 1), side="left"
+    ).astype(np.int32)
+    return order, bounds
+
+
+def pack_batch(num_players, winners, losers, min_bucket=MIN_BUCKET, dtype=np.float32):
+    """Pad one match batch to its bucket and precompute its grouping."""
+    winners = np.asarray(winners, dtype=np.int32)
+    losers = np.asarray(losers, dtype=np.int32)
+    if winners.shape != losers.shape or winners.ndim != 1:
+        raise ValueError("winners/losers must be 1-D arrays of equal length")
+    n = winners.shape[0]
+    b = bucket_size(n, min_bucket)
+    pad = b - n
+    w = np.concatenate([winners, np.zeros(pad, np.int32)])
+    l = np.concatenate([losers, np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(n, dtype), np.zeros(pad, dtype)])
+    perm, bounds = _group_by_player(np.concatenate([w, l]), num_players)
+    return PackedBatch(
+        jnp.asarray(w), jnp.asarray(l), jnp.asarray(valid),
+        jnp.asarray(perm), jnp.asarray(bounds), n,
+    )
+
+
+class PackedEpoch(NamedTuple):
+    """All batches of a match set, stacked for `ratings.elo_epoch`'s scan."""
+
+    winners: jax.Array  # (num_batches, B)
+    losers: jax.Array  # (num_batches, B)
+    valid: jax.Array  # (num_batches, B)
+    perms: jax.Array  # (num_batches, 2B)
+    bounds: jax.Array  # (num_batches, num_players+1)
+    num_real: int
+
+
+def pack_epoch(num_players, winners, losers, batch_size, dtype=np.float32):
+    """Split a match set into fixed-size batches and pack each one.
+
+    The last batch is padded to `batch_size` (the scan needs one fixed
+    shape). Grouping cost is one counting sort per batch — amortized
+    over every epoch/iteration run against the result.
+    """
+    winners = np.asarray(winners, dtype=np.int32)
+    losers = np.asarray(losers, dtype=np.int32)
+    n = winners.shape[0]
+    if n == 0:
+        raise ValueError("cannot pack an empty match set")
+    nb = -(-n // batch_size)
+    pad = nb * batch_size - n
+    w = np.concatenate([winners, np.zeros(pad, np.int32)]).reshape(nb, batch_size)
+    l = np.concatenate([losers, np.zeros(pad, np.int32)]).reshape(nb, batch_size)
+    valid = np.concatenate([np.ones(n, dtype), np.zeros(pad, dtype)]).reshape(
+        nb, batch_size
+    )
+    perms = np.empty((nb, 2 * batch_size), np.int32)
+    bounds = np.empty((nb, num_players + 1), np.int32)
+    for i in range(nb):
+        perms[i], bounds[i] = _group_by_player(
+            np.concatenate([w[i], l[i]]), num_players
+        )
+    return PackedEpoch(
+        jnp.asarray(w), jnp.asarray(l), jnp.asarray(valid),
+        jnp.asarray(perms), jnp.asarray(bounds), n,
+    )
+
+
+class ArenaEngine:
+    """Online Elo over a fixed player set, with batched Bradley–Terry.
+
+    One jitted update function serves every batch: its input shapes are
+    (bucket,) so the compile cache grows with the number of DISTINCT
+    BUCKETS touched, never with the number of distinct batch sizes
+    (`num_compiles()` exposes the cache size; tests pin it). The
+    ratings buffer is donated on every call — the old buffer is dead
+    the moment the update is dispatched, and XLA reuses it in place.
+    """
+
+    def __init__(
+        self,
+        num_players,
+        k=R.DEFAULT_K,
+        scale=R.DEFAULT_SCALE,
+        base=R.DEFAULT_BASE,
+        min_bucket=MIN_BUCKET,
+        dtype=jnp.float32,
+    ):
+        if num_players < 2:
+            raise ValueError("an arena needs at least two players")
+        self.num_players = num_players
+        self.k = k
+        self.scale = scale
+        self.min_bucket = min_bucket
+        self._dtype = dtype
+        self.ratings = jnp.full((num_players,), base, dtype)
+        self.matches_ingested = 0
+        # Everything ingested, kept host-side for Bradley–Terry refits.
+        self._winners = []
+        self._losers = []
+        self._update = jax.jit(
+            partial(R.elo_batch_update_sorted, k=k, scale=scale),
+            donate_argnums=(0,),
+        )
+
+    def update(self, winners, losers):
+        """Ingest one batch of outcomes and apply one batched Elo round."""
+        packed = pack_batch(
+            self.num_players, winners, losers, self.min_bucket, np.float32
+        )
+        self._winners.append(np.asarray(winners, np.int32))
+        self._losers.append(np.asarray(losers, np.int32))
+        self.matches_ingested += packed.num_real
+        self.ratings = self._update(
+            self.ratings,
+            packed.winners,
+            packed.losers,
+            packed.valid.astype(self._dtype),
+            packed.perm,
+            packed.bounds,
+        )
+        return self.ratings
+
+    def num_compiles(self):
+        """Jit-cache size of the update fn — the recompile budget the
+        bucketing exists to cap (one entry per bucket ever touched)."""
+        return self._update._cache_size()
+
+    def leaderboard(self, top_k=None):
+        """(player_id, rating) pairs, best first."""
+        r = np.asarray(self.ratings)
+        order = np.argsort(-r)
+        if top_k is not None:
+            order = order[:top_k]
+        return [(int(i), float(r[i])) for i in order]
+
+    def bt_strengths(self, num_iters=50, prior=0.1, batch_size=None):
+        """Batched Bradley–Terry MLE over every match ingested so far.
+
+        Independent of the online Elo state — a from-scratch MLE refit,
+        the standard periodic companion to online ratings. Runs as one
+        fused scan over `num_iters` MM steps (see `ratings.bt_fit`).
+        """
+        if not self._winners:
+            raise ValueError("no matches ingested")
+        w = np.concatenate(self._winners)
+        l = np.concatenate(self._losers)
+        b = bucket_size(len(w), self.min_bucket) if batch_size is None else batch_size
+        # One whole-set "batch": BT iterates over the full match set.
+        packed = pack_batch(self.num_players, w, l, b)
+        win_counts = jnp.asarray(
+            np.bincount(w, minlength=self.num_players).astype(np.float32)
+        )
+        fit = R.jit_bt_fit(self.num_players, num_iters=num_iters, prior=prior)
+        return fit(
+            packed.winners,
+            packed.losers,
+            packed.valid,
+            packed.perm,
+            packed.bounds,
+            win_counts,
+        )

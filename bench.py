@@ -238,9 +238,14 @@ def main() -> int:
     # with one unparseable line (a masquerade worse than silence). So
     # once a write has been attempted and failed, nothing more is
     # written: no JSON line is possible, and bench exits nonzero so the
-    # mangled/empty output reads as the failure it is.
+    # mangled/empty output reads as the failure it is. The flush sits
+    # INSIDE the guard: with a block-buffered stdout (file/pipe) a
+    # failed write only surfaces at flush time, and without this it
+    # would surface at interpreter-exit flush instead — CPython's
+    # exit 120, outside bench's own contract.
     try:
         print(line)
+        sys.stdout.flush()
         return 0
     except Exception:  # noqa: BLE001 — stdout itself is broken
         return 1  # no JSON line was possible

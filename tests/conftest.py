@@ -39,6 +39,19 @@ import pytest
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+# Force a 4-device CPU mesh for the arena sharding tests. XLA reads
+# XLA_FLAGS at first backend initialization, which happens on first
+# device use — after this conftest runs (sitecustomize merely IMPORTS
+# jax at interpreter start; that does not initialize a backend). The
+# bench/verify subprocess tests inherit the flag harmlessly: those
+# scripts never touch a jax device. Guarded so an explicit operator
+# setting wins.
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
 BASELINE_CONTENT = '{"north_star": "non-graftable"}\n'
 PAPERS_CONTENT = "# PAPERS\n"
 

@@ -51,7 +51,9 @@ import bench  # the LIVE repo's error-detail formatting, shared repo-wide
 
 # The runtime surface plus everything the suite needs to run. .git is
 # deliberately not copied: the hygiene tests build their own temp git
-# repos, and the copy must not look like a work tree.
+# repos, and the copy must not look like a work tree. arena/ and
+# pytest.ini ride along because the copied suite imports the arena
+# package and the registered `slow` marker.
 COPIED = (
     "bench.py",
     "verify_reference.py",
@@ -59,6 +61,8 @@ COPIED = (
     "BASELINE.json",
     "PAPERS.md",
     "SNIPPETS.md",
+    "pytest.ini",
+    "arena",
     "tests",
 )
 
@@ -135,9 +139,18 @@ MUTATIONS = (
     (
         "bench-breaks-one-line-contract",
         "bench.py",
-        '        print(line)\n        return 0',
-        '        print(line)\n        print("extra")\n        return 0',
+        '        print(line)\n        sys.stdout.flush()\n        return 0',
+        '        print(line)\n        print("extra")\n        sys.stdout.flush()\n        return 0',
         "bench must print exactly one JSON line (driver contract)",
+    ),
+    (
+        "bench-buffered-write-failure-escapes-guard",
+        "bench.py",
+        '        print(line)\n        sys.stdout.flush()\n        return 0',
+        '        print(line)\n        return 0',
+        "with a block-buffered stdout a failed write only surfaces at flush; "
+        "the flush must happen inside bench's own guard (rc 1), not at "
+        "interpreter exit (CPython's undocumented exit 120)",
     ),
     (
         "bench-print-failure-reads-as-success",
@@ -237,6 +250,11 @@ def run_suite(copy: pathlib.Path) -> subprocess.CompletedProcess:
             "-x",
             "-q",
             "--no-header",
+            # The audit measures the tier-1 surface; the slow-marked
+            # full-size benchmark would add minutes per mutant while
+            # enforcing no honesty property.
+            "-m",
+            "not slow",
             "-p",
             "no:cacheprovider",
             # See module docstring: the pattern-consistency test fails

@@ -1,0 +1,137 @@
+"""Engine-layer contracts: bucketing, recompile budget, donation, state.
+
+The load-bearing one is the zero-recompile property: shape-bucketed
+batching exists so production traffic with arbitrary batch sizes can
+NEVER trigger an unbounded stream of XLA compiles. It is asserted via
+the jit cache stats, not timing, so it cannot flake.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from arena import engine
+from arena import ratings as R
+from arena.engine import ArenaEngine, bucket_size
+
+
+def test_bucket_size_is_pow2_monotone_and_floored():
+    assert bucket_size(0) == engine.MIN_BUCKET
+    assert bucket_size(1) == engine.MIN_BUCKET
+    assert bucket_size(engine.MIN_BUCKET) == engine.MIN_BUCKET
+    assert bucket_size(engine.MIN_BUCKET + 1) == engine.MIN_BUCKET * 2
+    assert bucket_size(5000) == 8192
+    prev = 0
+    for n in range(0, 3000, 17):
+        b = bucket_size(n)
+        assert b >= n and b >= prev  # covers, monotone
+        assert b & (b - 1) == 0  # power of two
+        prev = b
+    with pytest.raises(ValueError):
+        bucket_size(-1)
+
+
+def test_pack_batch_pads_and_masks():
+    packed = engine.pack_batch(10, [1, 2, 3], [4, 5, 6])
+    b = engine.MIN_BUCKET
+    assert packed.winners.shape == (b,)
+    assert packed.perm.shape == (2 * b,)
+    assert packed.bounds.shape == (11,)
+    assert packed.num_real == 3
+    assert float(packed.valid.sum()) == 3.0
+    assert int(packed.bounds[-1]) == 2 * b  # boundaries cover everything
+
+
+def test_pack_batch_rejects_ragged_input():
+    with pytest.raises(ValueError):
+        engine.pack_batch(10, [1, 2], [3])
+
+
+def test_pack_epoch_rejects_empty():
+    with pytest.raises(ValueError):
+        engine.pack_epoch(10, [], [], batch_size=256)
+
+
+def test_padded_update_equals_unpadded():
+    """A padded slot must contribute exactly zero: updating through a
+    mostly-padding bucket equals the eager unpadded update."""
+    rng = np.random.default_rng(2)
+    w = rng.integers(0, 20, 37).astype(np.int32)
+    l = ((w + 1 + rng.integers(0, 19, 37)) % 20).astype(np.int32)
+    r = jnp.full((20,), R.DEFAULT_BASE, jnp.float32)
+    want = R.elo_batch_update(r, jnp.asarray(w), jnp.asarray(l))
+    eng = ArenaEngine(20)
+    got = eng.update(w, l)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+    assert eng.matches_ingested == 37
+
+
+def test_variable_batch_sizes_cause_zero_recompiles():
+    """The whole point of bucketing: every batch size within one bucket
+    hits one cache entry; a new bucket adds exactly one."""
+    eng = ArenaEngine(30)
+    rng = np.random.default_rng(4)
+
+    def feed(n):
+        w = rng.integers(0, 30, n).astype(np.int32)
+        l = ((w + 1 + rng.integers(0, 29, n)) % 30).astype(np.int32)
+        eng.update(w, l)
+
+    feed(10)
+    assert eng.num_compiles() == 1
+    for n in (1, 7, 100, 255, engine.MIN_BUCKET):  # all in the floor bucket
+        feed(n)
+    assert eng.num_compiles() == 1, "a same-bucket batch size recompiled"
+    feed(engine.MIN_BUCKET + 5)  # next bucket: exactly one more compile
+    assert eng.num_compiles() == 2
+    feed(engine.MIN_BUCKET * 2 - 1)  # same (second) bucket again
+    assert eng.num_compiles() == 2
+
+
+def test_update_donates_the_ratings_buffer():
+    """donate_argnums on the update: the pre-update ratings buffer is
+    consumed by the call (deleted), not left allocated behind the new
+    one. Verified effective on this CPU backend."""
+    eng = ArenaEngine(16)
+    before = eng.ratings
+    eng.update([1, 2], [3, 4])
+    assert before.is_deleted()
+
+
+def test_leaderboard_orders_by_rating():
+    eng = ArenaEngine(5)
+    # Player 0 beats everyone twice; player 4 loses everything extra.
+    w = [0, 0, 0, 0, 1, 2, 3]
+    l = [1, 2, 3, 4, 4, 4, 4]
+    eng.update(w, l)
+    board = eng.leaderboard()
+    assert [p for p, _ in board][0] == 0
+    assert [p for p, _ in board][-1] == 4
+    assert len(eng.leaderboard(top_k=2)) == 2
+    ratings = [r for _, r in board]
+    assert ratings == sorted(ratings, reverse=True)
+
+
+def test_engine_bt_strengths_rank_ingested_history():
+    rng = np.random.default_rng(9)
+    eng = ArenaEngine(8)
+    # Transitive-ish traffic in several online batches.
+    for _ in range(4):
+        a = rng.integers(0, 8, 200)
+        b = (a + 1 + rng.integers(0, 7, 200)) % 8
+        w = np.minimum(a, b).astype(np.int32)
+        l = np.maximum(a, b).astype(np.int32)
+        eng.update(w, l)
+    strengths = np.asarray(eng.bt_strengths(num_iters=40))
+    assert list(np.argsort(-strengths)) == list(range(8))
+
+
+def test_engine_bt_requires_history():
+    with pytest.raises(ValueError):
+        ArenaEngine(4).bt_strengths()
+
+
+def test_engine_rejects_degenerate_player_count():
+    with pytest.raises(ValueError):
+        ArenaEngine(1)

@@ -1,0 +1,197 @@
+"""Numerical properties of the arena rating math (arena/ratings.py).
+
+What must hold for the bench's speedup claim to mean anything:
+
+- the scatter-free sorted segment sum IS a segment sum (pinned against
+  `jax.ops.segment_sum` on random data);
+- jitting changes nothing but speed (jit-vs-eager equivalence);
+- batched updates are order-free within a batch (permutation
+  invariance — the property that makes the batch semantics coherent);
+- both Elo and Bradley–Terry recover the true total order on synthetic
+  transitive data (the engine actually *rates*);
+- the optimized path agrees with the deliberately naive baseline it is
+  benchmarked against.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from arena import baseline, engine
+from arena import ratings as R
+
+N_PLAYERS = 50
+
+
+def make_matches(num_matches, num_players=N_PLAYERS, seed=0):
+    """Stochastic outcomes from linearly spaced true log-strengths."""
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, num_players, num_matches)
+    b = (a + 1 + rng.integers(0, num_players - 1, num_matches)) % num_players
+    strength = np.linspace(2.5, -2.5, num_players)
+    p_a = 1.0 / (1.0 + np.exp(strength[b] - strength[a]))
+    a_wins = rng.random(num_matches) < p_a
+    return (
+        np.where(a_wins, a, b).astype(np.int32),
+        np.where(a_wins, b, a).astype(np.int32),
+    )
+
+
+def test_sorted_segment_sum_equals_segment_sum():
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 37, 500).astype(np.int32)
+    vals = rng.normal(size=500).astype(np.float32)
+    perm, bounds = engine._group_by_player(ids, 37)
+    got = R.sorted_segment_sum(jnp.asarray(vals), jnp.asarray(perm), jnp.asarray(bounds))
+    want = jax.ops.segment_sum(jnp.asarray(vals), jnp.asarray(ids), num_segments=37)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-4)
+
+
+def test_sorted_segment_sum_empty_segments_are_zero():
+    """Players with no matches must get exactly 0, not garbage from
+    neighboring boundary offsets."""
+    ids = np.array([3, 3, 7], np.int32)
+    vals = np.array([1.0, 2.0, 4.0], np.float32)
+    perm, bounds = engine._group_by_player(ids, 10)
+    got = np.asarray(
+        R.sorted_segment_sum(jnp.asarray(vals), jnp.asarray(perm), jnp.asarray(bounds))
+    )
+    want = np.zeros(10, np.float32)
+    want[3], want[7] = 3.0, 4.0
+    np.testing.assert_array_equal(got, want)
+
+
+def test_elo_batch_update_jit_vs_eager():
+    w, l = make_matches(300)
+    r = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    wj, lj = jnp.asarray(w), jnp.asarray(l)
+    eager = R.elo_batch_update(r, wj, lj)
+    jitted = jax.jit(R.elo_batch_update)(r, wj, lj)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted), atol=1e-4)
+
+
+def test_sorted_path_matches_scatter_path():
+    """The hot path (sorted cumsum) and the plain segment_sum scatter
+    formulation are the same update."""
+    w, l = make_matches(512)
+    packed = engine.pack_batch(N_PLAYERS, w, l, min_bucket=512)
+    r = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    scatter = R.elo_batch_update(r, packed.winners, packed.losers, packed.valid)
+    sorted_ = R.elo_batch_update_sorted(
+        r, packed.winners, packed.losers, packed.valid, packed.perm, packed.bounds
+    )
+    np.testing.assert_allclose(np.asarray(scatter), np.asarray(sorted_), atol=1e-3)
+
+
+def test_elo_epoch_jit_vs_eager():
+    w, l = make_matches(600)
+    packed = engine.pack_epoch(N_PLAYERS, w, l, batch_size=256)
+    r = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    args = (packed.winners, packed.losers, packed.valid, packed.perms, packed.bounds)
+    eager = R.elo_epoch(r, *args)
+    jitted = R.jit_elo_epoch(N_PLAYERS, donate=False)(r, *args)
+    np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted), atol=1e-3)
+
+
+def test_elo_batch_permutation_invariance():
+    """Shuffling the matches WITHIN a batch must not change the
+    ratings: every expected score reads the ratings at batch start."""
+    w, l = make_matches(400)
+    r = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    out1 = R.elo_batch_update(r, jnp.asarray(w), jnp.asarray(l))
+    shuffle = np.random.default_rng(7).permutation(len(w))
+    out2 = R.elo_batch_update(r, jnp.asarray(w[shuffle]), jnp.asarray(l[shuffle]))
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-3)
+    # Same through the sorted hot path (fresh ingest of the shuffled batch).
+    p1 = engine.pack_batch(N_PLAYERS, w, l)
+    p2 = engine.pack_batch(N_PLAYERS, w[shuffle], l[shuffle])
+    s1 = R.elo_batch_update_sorted(r, p1.winners, p1.losers, p1.valid, p1.perm, p1.bounds)
+    s2 = R.elo_batch_update_sorted(r, p2.winners, p2.losers, p2.valid, p2.perm, p2.bounds)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), atol=1e-3)
+
+
+def test_optimized_elo_agrees_with_naive_baseline():
+    """The pair the bench compares must compute the same thing."""
+    w, l = make_matches(2000)
+    batch = 256
+    naive = baseline.elo_epoch_naive(N_PLAYERS, w, l, batch)
+    packed = engine.pack_epoch(N_PLAYERS, w, l, batch)
+    r = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    jitted = R.jit_elo_epoch(N_PLAYERS, donate=False)(
+        r, packed.winners, packed.losers, packed.valid, packed.perms, packed.bounds
+    )
+    assert float(np.abs(np.asarray(jitted) - naive).max()) < 0.05
+
+
+def test_elo_recovers_total_order_on_transitive_data():
+    """On strongly separated strengths, a few epochs of batched Elo
+    must rank every player correctly (true order is 0 > 1 > ... > n-1)."""
+    num_players = 12
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, num_players, 3000)
+    b = (a + 1 + rng.integers(0, num_players - 1, 3000)) % num_players
+    # Deterministically transitive: the lower index always wins.
+    w = np.minimum(a, b).astype(np.int32)
+    l = np.maximum(a, b).astype(np.int32)
+    packed = engine.pack_epoch(num_players, w, l, batch_size=256)
+    r = jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32)
+    epoch = R.jit_elo_epoch(num_players, donate=False)
+    for _ in range(3):
+        r = epoch(r, packed.winners, packed.losers, packed.valid, packed.perms, packed.bounds)
+    assert list(np.argsort(-np.asarray(r))) == list(range(num_players))
+
+
+def test_bt_recovers_total_order_and_matches_naive():
+    w, l = make_matches(4000, seed=11)
+    packed = engine.pack_batch(N_PLAYERS, w, l, min_bucket=4096)
+    win_counts = jnp.asarray(
+        np.bincount(w, minlength=N_PLAYERS).astype(np.float32)
+    )
+    fit = R.jit_bt_fit(N_PLAYERS, num_iters=60)
+    strengths = np.asarray(
+        fit(packed.winners, packed.losers, packed.valid, packed.perm, packed.bounds, win_counts)
+    )
+    # Spearman-style check: the fitted ranking must essentially match
+    # the true one (strengths are linspace-separated; a tiny number of
+    # adjacent swaps from sampling noise is tolerable).
+    true_rank = np.arange(N_PLAYERS)
+    fitted_rank = np.empty(N_PLAYERS)
+    fitted_rank[np.argsort(-strengths)] = np.arange(N_PLAYERS)
+    corr = np.corrcoef(true_rank, fitted_rank)[0, 1]
+    assert corr > 0.98, f"rank correlation {corr}"
+    # Naive MM agrees with the vectorized MM.
+    naive = baseline.bt_fit_naive(N_PLAYERS, w, l, num_iters=60)
+    np.testing.assert_allclose(
+        strengths, naive, rtol=5e-2, atol=1e-3
+    )
+
+
+def test_bt_mm_step_does_not_decrease_likelihood():
+    """MM is monotone in the (regularized) likelihood; check the plain
+    data likelihood over a few steps from a cold start."""
+    w, l = make_matches(1500, seed=5)
+    packed = engine.pack_batch(N_PLAYERS, w, l, min_bucket=2048)
+    win_counts = jnp.asarray(np.bincount(w, minlength=N_PLAYERS).astype(np.float32))
+    p = jnp.ones((N_PLAYERS,), jnp.float32)
+    prev = float(
+        R.bt_log_likelihood(p, packed.winners, packed.losers, packed.valid)
+    )
+    step = jax.jit(R.bt_mm_step)
+    for _ in range(5):
+        p = step(p, packed.winners, packed.losers, packed.valid, packed.perm,
+                 packed.bounds, win_counts, 0.1)
+        cur = float(
+            R.bt_log_likelihood(p, packed.winners, packed.losers, packed.valid)
+        )
+        assert cur >= prev - 1e-3
+        prev = cur
+
+
+def test_elo_expected_is_the_classic_formula():
+    """The sigmoid rewrite must be the textbook 10** curve."""
+    for rw, rl in [(1500.0, 1500.0), (1700.0, 1400.0), (1200.0, 1900.0)]:
+        got = float(R.elo_expected(jnp.float32(rw), jnp.float32(rl)))
+        want = baseline.elo_expected_naive(rw, rl)
+        assert got == pytest.approx(want, abs=1e-5)

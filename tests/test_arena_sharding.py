@@ -1,0 +1,119 @@
+"""Sharded-update correctness on a forced multi-device CPU mesh.
+
+conftest.py sets XLA_FLAGS=--xla_force_host_platform_device_count=4
+before the backend initializes, so these tests exercise the real
+mesh/shard_map/psum machinery with no TPU. They pin semantics (sharded
+== unsharded), not wall-clock — on this 1-core image host devices
+share a core.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from arena import ratings as R
+from arena import sharding
+
+
+def test_forced_cpu_mesh_has_multiple_devices():
+    """If this fails the XLA_FLAGS forcing in conftest.py broke and
+    every other test in this file is silently single-device."""
+    assert len(jax.devices()) >= 2
+
+
+def make_batch(num_matches, num_players, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.integers(0, num_players, num_matches).astype(np.int32)
+    l = ((w + 1 + rng.integers(0, num_players - 1, num_matches)) % num_players).astype(
+        np.int32
+    )
+    return jnp.asarray(w), jnp.asarray(l)
+
+
+def test_sharded_update_equals_unsharded():
+    mesh = sharding.build_mesh()
+    ndev = mesh.devices.size
+    w, l = make_batch(64 * ndev, 40)
+    r = jnp.full((40,), R.DEFAULT_BASE, jnp.float32)
+    want = R.elo_batch_update(r, w, l)
+    got = sharding.shard_elo_batch_update(mesh, r, w, l)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+def test_sharded_epoch_equals_unsharded_epoch():
+    mesh = sharding.build_mesh()
+    ndev = mesh.devices.size
+    nb, b, n = 3, 32 * ndev, 25
+    rng = np.random.default_rng(1)
+    w = rng.integers(0, n, (nb, b)).astype(np.int32)
+    l = ((w + 1 + rng.integers(0, n - 1, (nb, b))) % n).astype(np.int32)
+    valid = np.ones((nb, b), np.float32)
+    r0 = jnp.full((n,), R.DEFAULT_BASE, jnp.float32)
+    want = r0
+    for i in range(nb):
+        want = R.elo_batch_update(want, jnp.asarray(w[i]), jnp.asarray(l[i]))
+    epoch = sharding.jit_sharded_elo_epoch(mesh)
+    got = epoch(r0, jnp.asarray(w), jnp.asarray(l), jnp.asarray(valid))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+
+
+def test_sharded_update_rejects_indivisible_batch():
+    mesh = sharding.build_mesh()
+    if mesh.devices.size == 1:
+        pytest.fail("forced mesh unexpectedly single-device")
+    w, l = make_batch(mesh.devices.size * 8 + 1, 10)
+    r = jnp.full((10,), R.DEFAULT_BASE, jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        sharding.shard_elo_batch_update(mesh, r, w, l)
+
+
+def test_build_mesh_subset_and_bounds():
+    mesh = sharding.build_mesh(num_devices=2)
+    assert mesh.devices.size == 2
+    assert mesh.axis_names == (sharding.DATA_AXIS,)
+    with pytest.raises(ValueError, match="only"):
+        sharding.build_mesh(num_devices=len(jax.devices()) + 1)
+
+
+def test_match_partition_rules_first_match_wins_and_scalars_replicate():
+    tree = {
+        "ratings": jnp.zeros((16,)),
+        "bt": {"strengths": jnp.zeros((16,)), "prior": jnp.float32(0.1)},
+        "counts": jnp.zeros((16,), jnp.int32),
+    }
+    rules = [
+        (r"bt/strengths", P(sharding.DATA_AXIS)),
+        (r"ratings|counts", P(sharding.DATA_AXIS)),
+    ]
+    specs = sharding.match_partition_rules(rules, tree)
+    assert specs["ratings"] == P(sharding.DATA_AXIS)
+    assert specs["bt"]["strengths"] == P(sharding.DATA_AXIS)
+    assert specs["counts"] == P(sharding.DATA_AXIS)
+    # The scalar leaf matched no rule and must not need one.
+    assert specs["bt"]["prior"] == P()
+
+
+def test_match_partition_rules_unmatched_leaf_is_an_error():
+    tree = {"mystery": jnp.zeros((8,))}
+    with pytest.raises(ValueError, match="no partition rule matched"):
+        sharding.match_partition_rules([(r"ratings", P(sharding.DATA_AXIS))], tree)
+
+
+def test_match_partition_rules_regex_is_search_not_fullmatch():
+    """Rules behave like the SNIPPETS pattern: re.search over the
+    '/'-joined path, so a substring rule covers nested state."""
+    tree = {"opt_state": {"ratings_momentum": jnp.zeros((4, 4))}}
+    specs = sharding.match_partition_rules([(r"ratings", P(None, sharding.DATA_AXIS))], tree)
+    assert specs["opt_state"]["ratings_momentum"] == P(None, sharding.DATA_AXIS)
+
+
+def test_place_replicated_puts_state_on_every_device():
+    mesh = sharding.build_mesh()
+    r = sharding.place_replicated(mesh, jnp.arange(12.0))
+    assert len(r.sharding.device_set) == mesh.devices.size
+    np.testing.assert_array_equal(np.asarray(r), np.arange(12.0))

@@ -282,6 +282,30 @@ def test_broken_stdout_exits_nonzero_never_silent_success(
     assert bench.main() == 1
 
 
+def test_buffered_write_failure_exits_nonzero_inside_the_guard(
+    tmp_path, fake_repo, monkeypatch
+):
+    """With a block-buffered stdout (file/pipe) a doomed write lands in
+    the buffer and print() returns happily; the failure only surfaces
+    at flush. bench flushes INSIDE its guard so that failure is ITS
+    rc 1, not CPython's interpreter-exit status 120 (which is outside
+    bench's documented contract)."""
+    monkeypatch.setenv("GRAFT_REFERENCE_PATH", str(tmp_path / "ref"))
+    monkeypatch.setenv("GRAFT_REPO_PATH", str(fake_repo))
+    failures = iter([OSError(28, "No space left on device")])
+
+    def deferred_failure():
+        # Raise exactly once — for the flush bench itself performs.
+        # pytest's capture machinery flushes this same stdout object
+        # again during teardown, before the monkeypatch is undone, and
+        # a second raise there would fail the test from the outside.
+        for exc in failures:
+            raise exc
+
+    monkeypatch.setattr(sys.stdout, "flush", deferred_failure)
+    assert bench.main() == 1
+
+
 def test_failed_write_never_appends_to_a_partial_line(
     tmp_path, fake_repo, monkeypatch
 ):

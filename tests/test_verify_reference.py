@@ -202,6 +202,38 @@ def test_bare_like_layout_without_head_is_working_tree(tmp_path):
     )
 
 
+def test_empty_entries_classify_as_emptied_between_walks():
+    """classify_manifest_shape only runs after the counting walk saw a
+    non-empty tree; an empty entries list means the mount emptied in
+    between and must NOT read as 'working-tree' (a non-empty claim
+    with entry_count 0 is internally contradictory evidence)."""
+    assert (
+        verify_reference.classify_manifest_shape([])
+        == verify_reference.MANIFEST_SHAPE_EMPTIED
+        == "emptied-between-walks"
+    )
+
+
+def test_tree_emptied_between_walks_manifest_never_claims_non_empty(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """The race end-to-end: counting walk sees entries, manifest walk
+    sees none. The gate still reports drift (the count DID change), but
+    the written manifest must describe the instability — not assert 'a
+    NON-EMPTY reference tree was observed' above entry_count 0."""
+    ref = tmp_path / "ref"
+    (ref / "src").mkdir(parents=True)
+    monkeypatch.setattr(verify_reference, "build_manifest", lambda reference: [])
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest_shape"] == "emptied-between-walks"
+    manifest = json.loads((fake_repo / verify_reference.MANIFEST_NAME).read_text())
+    assert manifest["shape"] == "emptied-between-walks"
+    assert manifest["entry_count"] == 0
+    assert "NON-EMPTY" not in manifest["comment"]
+    assert "EMPTIED BETWEEN WALKS" in manifest["comment"]
+
+
 def test_matching_nonempty_vcs_only_fingerprint_keeps_the_shape_warning(
     tmp_path, monkeypatch, capsys
 ):
