@@ -169,6 +169,12 @@ COUNT_NOT_A_DIRECTORY = "mount_not_a_directory"
 # working files would wrongly conclude "still nothing here".
 MANIFEST_SHAPE_VCS_ONLY = "vcs-metadata-only"
 MANIFEST_SHAPE_WORKING_TREE = "working-tree"
+# The manifest walk runs AFTER the counting walk; if the mount empties
+# in between, the entries list is empty and neither non-empty shape is
+# true. A distinct shape keeps the manifest from ever claiming "a
+# NON-EMPTY tree was observed" with entry_count 0 — internally
+# contradictory evidence.
+MANIFEST_SHAPE_EMPTIED = "emptied-between-walks"
 # Top-level names that together are the anatomy of a bare git
 # repository directory (objects/refs/HEAD are the load-bearing trio;
 # the rest are common companions). Used only as a *subset* test — a
@@ -532,7 +538,14 @@ def classify_manifest_shape(entries: list) -> str:
     source lives in the object store, so "no README, no entry points"
     is evidence about the PACKAGING, not the capabilities, and the
     playbook must materialize the committed tree before concluding
-    anything (SURVEY_REWRITE.md)."""
+    anything (SURVEY_REWRITE.md).
+
+    An EMPTY entries list gets its own shape ("emptied-between-walks"):
+    this function only runs after the counting walk saw a non-empty
+    tree, so no entries means the mount changed underfoot — evidence of
+    instability, never of a working tree."""
+    if not entries:
+        return MANIFEST_SHAPE_EMPTIED
     top = {entry["path"].split("/", 1)[0] for entry in entries}
     if top == {".git"}:
         return MANIFEST_SHAPE_VCS_ONLY
@@ -584,8 +597,19 @@ def write_manifest(
         entries = build_manifest(reference)
     if shape is None:
         shape = classify_manifest_shape(entries)
-    payload = {
-        "comment": (
+    if shape == MANIFEST_SHAPE_EMPTIED:
+        # The counting walk saw entries; this walk saw none. The
+        # comment must describe the race, not assert a non-empty tree
+        # the recorded entry_count (0) would contradict.
+        comment = (
+            "The reference tree EMPTIED BETWEEN WALKS: the counting "
+            "walk observed a non-empty tree, but the manifest walk "
+            "found no entries. The mount is changing underfoot — this "
+            "manifest is evidence of instability, not a survey "
+            "baseline; re-run the gate once the mount settles."
+        )
+    else:
+        comment = (
             "A NON-EMPTY reference tree was observed. SURVEY.md (which "
             "surveyed an empty tree) is obsolete and must be rewritten "
             "from this real tree before any build work; this manifest is "
@@ -598,7 +622,9 @@ def write_manifest(
                 if shape == MANIFEST_SHAPE_VCS_ONLY
                 else ""
             )
-        ),
+        )
+    payload = {
+        "comment": comment,
         "reference_path": str(reference),
         "shape": shape,
         "entry_count": len(entries),
