@@ -1,0 +1,31 @@
+"""arena.analysis — static analysis + runtime sanitizers for the hot path.
+
+Two halves, deliberately decoupled:
+
+- `arena.analysis.jaxlint` — AST-based lint rules (stdlib only, never
+  imports jax) enforcing the engine's performance invariants at source
+  level. CLI: `python -m arena.analysis [paths...]`; rc 0 = clean,
+  rc 1 = findings, rc 2 = bad path. Findings are suppressible inline
+  with `# jaxlint: disable=<rule>`.
+- `arena.analysis.sanitize` — opt-in RUNTIME checks (imports jax, and
+  deliberately NOT re-exported here): `checked()` wires
+  jax_debug_nans/jax_debug_infs, `RecompileSentinel` pins
+  zero-new-compiles after warmup, and `donation_guard` poisons donated
+  buffers so reuse fails loudly.
+
+The embedded bad-example corpus lives in `arena/analysis/badcorpus/`
+(one file per rule, each tripping exactly its rule). Default directory
+walks skip it; lint it explicitly to see every rule fire:
+
+    python -m arena.analysis arena/analysis/badcorpus
+"""
+
+from arena.analysis.jaxlint import (
+    RULES,
+    Finding,
+    lint_paths,
+    lint_source,
+    main,
+)
+
+__all__ = ["RULES", "Finding", "lint_paths", "lint_source", "main"]

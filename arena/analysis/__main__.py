@@ -1,0 +1,8 @@
+"""`python -m arena.analysis` — run jaxlint over the given paths."""
+
+import sys
+
+from arena.analysis.jaxlint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

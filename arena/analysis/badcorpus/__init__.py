@@ -1,0 +1,6 @@
+"""Bad-example corpus for jaxlint: one file per rule, each written to
+trip exactly its own rule. NEVER imported at runtime — these modules
+exist to be parsed by the linter (the tier-1 test asserts every shipped
+rule fires at least once here, and `python -m arena.analysis` exits
+non-zero over this directory). Default directory walks skip it, so the
+clean-tree lint stays clean."""

@@ -1,0 +1,16 @@
+"""jaxlint corpus: host-synchronizing calls inside a jitted body.
+
+`print`, `float()`, `np.asarray`, and `.item()` each force a device
+round-trip (or crash under tracing). Rule: host-sync-in-jit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_loss(x):
+    total = jnp.sum(x)
+    print("loss so far", float(total))
+    host_copy = np.asarray(x)
+    return total + host_copy.item()

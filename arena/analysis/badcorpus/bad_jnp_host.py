@@ -1,0 +1,16 @@
+"""jaxlint corpus: device jnp compute in a host-side NumPy ingest path.
+
+This is `engine.pack_batch`'s counting-sort territory: the arrays are
+host NumPy, the result feeds a host layout, and every jnp op here pays
+a device dispatch plus transfers for work np does in-place.
+Rule: jnp-on-host-path."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_ids(ids, num_players):
+    ids = np.asarray(ids, np.int32)
+    order = jnp.argsort(ids)
+    bounds = jnp.searchsorted(ids[np.asarray(order)], np.arange(num_players + 1))
+    return order, bounds

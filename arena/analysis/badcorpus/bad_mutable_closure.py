@@ -1,0 +1,15 @@
+"""jaxlint corpus: a jitted function closing over mutable host state.
+
+Tracing captures `history` once; the append never happens on later
+calls (the traced side effect is dropped), and any value read from it
+is frozen at trace time. Rule: mutable-closure."""
+
+import jax
+
+history = []
+
+
+@jax.jit
+def traced_update(x):
+    history.append(x)
+    return x * 2.0

@@ -1,0 +1,23 @@
+"""jaxlint corpus: shape-derived scalars flowing into jit arguments.
+
+`batch.shape[0]` changes with every distinct batch size; without
+static_argnums (or the engine's pow2 bucketing) each size means a new
+trace. Rule: nonstatic-shape-arg."""
+
+import jax
+
+
+def _kernel(x, n):
+    return x * n
+
+
+apply_kernel = jax.jit(_kernel)
+
+
+def rescale(batch):
+    n = batch.shape[0]
+    return apply_kernel(batch, n)
+
+
+def rescale_direct(batch):
+    return apply_kernel(batch, batch.shape[0])

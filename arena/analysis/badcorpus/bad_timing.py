@@ -1,0 +1,16 @@
+"""jaxlint corpus: timing asynchronous dispatch without blocking.
+
+JAX dispatch is asynchronous — the second clock read happens while the
+device is still computing, so `elapsed` measures dispatch overhead,
+not the work. Rule: timing-without-block."""
+
+import time
+
+import jax.numpy as jnp
+
+
+def time_epoch(x):
+    t0 = time.perf_counter()
+    y = jnp.dot(x, x)
+    elapsed = time.perf_counter() - t0
+    return y, elapsed

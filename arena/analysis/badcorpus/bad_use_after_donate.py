@@ -1,0 +1,20 @@
+"""jaxlint corpus: reading a buffer after donating it.
+
+`state` is donated to the update (donate_argnums=(0,)); XLA may have
+reused its memory for the result, so the later read aliases freed or
+overwritten storage. Rule: use-after-donate."""
+
+import jax
+
+
+def _update(state, delta):
+    return state + delta
+
+
+donating_update = jax.jit(_update, donate_argnums=(0,))
+
+
+def step_and_leak(state, delta):
+    new_state = donating_update(state, delta)
+    stale = state + 1.0
+    return new_state, stale

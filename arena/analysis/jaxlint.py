@@ -1,0 +1,731 @@
+"""jaxlint — JAX-aware static analysis guarding the arena hot path.
+
+PR 1's measured speedup rests on invariants no runtime check enforces
+by default: zero recompiles across variable batch sizes (the pow2
+shape-bucket contract), safe use of donated buffers, no host round
+trips inside jitted bodies, honest timing of asynchronous dispatch,
+and NumPy — not jnp — on host-side ingest paths. Each rule here is one
+of those invariants expressed over the stdlib `ast`, so a regression
+is caught at lint time instead of as a silently-lost speedup in a
+bench run weeks later.
+
+Design:
+
+- **No new dependencies.** Parsing is `ast`, comment handling is
+  `tokenize`, the CLI is `argparse`. This module never imports jax —
+  lint runs and lint TESTS need no accelerator stack (the `-m
+  arena.analysis` entrypoint does import the arena package, whose
+  __init__ pulls jax; import `arena.analysis.jaxlint` directly to
+  stay jax-free).
+- **Rule registry.** Every rule is a function registered via `@rule`
+  with a kebab-case name and a one-line summary; `RULES` is the
+  registry the CLI, the tests, and the bad-example corpus all iterate.
+  A rule receives a `ModuleContext` (one shared analysis pass: jitted
+  callables + their static/donate info, traced function bodies,
+  suppression table) and yields `Finding`s.
+- **Heuristic, not sound.** This is a linter: dotted-name matching and
+  straight-line dataflow, not type inference. Rules are tuned so the
+  CLEAN TREE LINTS CLEAN (a tier-1 test pins zero findings over
+  `arena/`, `bench.py`, `tests/`) and every rule fires on the embedded
+  corpus (`arena/analysis/badcorpus/`, excluded from default walks).
+- **Suppressible.** `# jaxlint: disable=<rule>[,<rule>...]` on the
+  offending line suppresses named rules there; `disable=all` mutes the
+  line. Deliberate violations (e.g. the sanitizer tests proving
+  reuse-after-donate fails loudly) carry the comment as documentation.
+
+What "jitted" means to the linter (tracked per module):
+
+- a `def` decorated with `jax.jit` / `jit` / `jax.jit(...)` /
+  `partial(jax.jit, ...)` / `shard_map` / `partial(shard_map, ...)`;
+- a `def` whose name is later passed to `jax.jit(f, ...)` (including
+  through `partial(f, ...)` inside the jit call);
+- an assignment `name = jax.jit(...)` — `name` becomes a known-jitted
+  callable, with `static_argnums`/`static_argnames` and
+  `donate_argnums`/`donate_argnames` read off the call;
+- the repo's own factories: `jit_elo_epoch(...)` (donates argnum 0
+  unless `donate=False`), `jit_bt_fit(...)`, and
+  `sanitize.donation_guard(fn, donate_argnums=...)`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import pathlib
+import sys
+import tokenize
+
+# --- findings and the rule registry ---------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    check: object  # ModuleContext -> iterable of Finding
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(name, summary):
+    def register(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate rule name {name!r}")
+        RULES[name] = Rule(name, summary, fn)
+        return fn
+
+    return register
+
+
+# --- shared AST helpers ----------------------------------------------------
+
+
+def dotted(node) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def scope_walk(scope):
+    """ast.walk confined to one scope: yields nodes under `scope`
+    without descending into nested function/class definitions, so a
+    call is attributed to exactly one scope."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_TRACER_DECORATORS = _JIT_NAMES | {"shard_map", "jax.experimental.shard_map.shard_map"}
+# Repo factories returning jitted callables: tail name -> (static, donate).
+# `static=True` means "shape handling is the factory's contract" — the
+# nonstatic-shape-arg rule stays quiet on calls to these.
+_FACTORY_TAILS = {
+    "jit_elo_epoch": (True, (0,)),
+    "jit_bt_fit": (True, ()),
+}
+_DONATION_GUARD_TAIL = "donation_guard"
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """What the linter knows about one jitted callable."""
+
+    has_static: bool = False
+    donate_argnums: tuple = ()
+
+
+def _literal_argnums(keyword_value) -> tuple:
+    """donate_argnums=(0,) / 0 / [0, 1] -> a tuple of ints; unknown -> (0,)."""
+    try:
+        val = ast.literal_eval(keyword_value)
+    except (ValueError, TypeError, SyntaxError):
+        return (0,)
+    if isinstance(val, int):
+        return (val,)
+    if isinstance(val, (tuple, list)) and all(isinstance(v, int) for v in val):
+        return tuple(val)
+    return (0,)
+
+
+def _jit_call_info(call: ast.Call) -> JitInfo | None:
+    """JitInfo if `call` constructs a jitted callable, else None."""
+    fname = dotted(call.func)
+    if fname is None:
+        return None
+    tail = fname.split(".")[-1]
+    if fname in _JIT_NAMES:
+        info = JitInfo()
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                info.has_static = True
+            elif kw.arg in ("donate_argnums", "donate_argnames"):
+                info.donate_argnums = _literal_argnums(kw.value)
+        return info
+    if tail in _FACTORY_TAILS:
+        static, donate = _FACTORY_TAILS[tail]
+        for kw in call.keywords:
+            if kw.arg == "donate" and isinstance(kw.value, ast.Constant):
+                donate = (0,) if kw.value.value else ()
+        return JitInfo(has_static=static, donate_argnums=donate)
+    if tail == _DONATION_GUARD_TAIL:
+        donate = (0,)
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                donate = _literal_argnums(kw.value)
+        return JitInfo(has_static=True, donate_argnums=donate)
+    return None
+
+
+def _decorator_is_tracing(dec) -> bool:
+    name = dotted(dec)
+    if name in _TRACER_DECORATORS:
+        return True
+    if isinstance(dec, ast.Call):
+        cname = dotted(dec.func)
+        if cname in _TRACER_DECORATORS:
+            return True
+        # functools.partial(jax.jit, ...) / partial(shard_map, ...)
+        if cname and cname.split(".")[-1] == "partial" and dec.args:
+            return dotted(dec.args[0]) in _TRACER_DECORATORS
+    return False
+
+
+# --- per-module shared analysis -------------------------------------------
+
+
+class ModuleContext:
+    """One parse + one discovery pass, shared by every rule."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = _suppression_table(source)
+        # dotted target name -> JitInfo, collected from every assignment
+        # anywhere in the module (covers `self._update = jax.jit(...)`
+        # in __init__ being called from another method).
+        self.jitted_callables: dict[str, JitInfo] = {}
+        # FunctionDef nodes whose bodies are traced by jit/shard_map.
+        self.traced_defs: list[ast.FunctionDef] = []
+        self._discover()
+
+    def _discover(self):
+        defs_by_name = {}
+        traced_names = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, node)
+                if any(_decorator_is_tracing(d) for d in node.decorator_list):
+                    traced_names.add(node.name)
+            elif isinstance(node, ast.Call):
+                info = _jit_call_info(node)
+                if info is None:
+                    continue
+                # jax.jit(f, ...) / jax.jit(partial(f, ...)): the wrapped
+                # def (if visible in this module) is traced.
+                for arg in node.args[:1]:
+                    target = arg
+                    if isinstance(target, ast.Call):
+                        tname = dotted(target.func)
+                        if tname and tname.split(".")[-1] == "partial" and target.args:
+                            target = target.args[0]
+                    tname = dotted(target)
+                    if tname and "." not in tname:
+                        traced_names.add(tname)
+            if isinstance(node, ast.Assign):
+                value = node.value
+                if isinstance(value, ast.Call):
+                    info = _jit_call_info(value)
+                    if info is not None:
+                        for tgt in node.targets:
+                            tname = dotted(tgt)
+                            if tname:
+                                self.jitted_callables[tname] = info
+        self.traced_defs = [
+            d for name, d in defs_by_name.items() if name in traced_names
+        ]
+        self._traced_def_ids = {id(d) for d in self.traced_defs}
+
+    def is_traced_def(self, node) -> bool:
+        return id(node) in self._traced_def_ids
+
+    def finding(self, node, rule_name, message) -> Finding:
+        return Finding(self.path, node.lineno, node.col_offset, rule_name, message)
+
+
+def _suppression_table(source: str) -> dict[int, set[str]]:
+    """lineno -> set of rule names disabled there ({'all'} mutes the line)."""
+    table: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string.lstrip("#").strip()
+            if not text.startswith("jaxlint:"):
+                continue
+            directive = text[len("jaxlint:"):].strip()
+            if directive.startswith("disable="):
+                names = {n.strip() for n in directive[len("disable="):].split(",")}
+                table.setdefault(tok.start[0], set()).update(n for n in names if n)
+    except tokenize.TokenError:
+        pass  # unterminated source: lint what parsed, suppress nothing
+    return table
+
+
+# --- rules ----------------------------------------------------------------
+
+_MUTABLE_CONSTRUCTORS = {"list", "dict", "set", "collections.defaultdict", "defaultdict"}
+
+
+def _mutable_bindings(scope_node) -> dict[str, ast.AST]:
+    """Names bound DIRECTLY in `scope_node` to mutable literals/ctors."""
+    out = {}
+    body = getattr(scope_node, "body", [])
+    for stmt in body:
+        if isinstance(stmt, ast.Assign):
+            value = stmt.value
+            mutable = isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                         ast.DictComp, ast.SetComp))
+            if isinstance(value, ast.Call) and dotted(value.func) in _MUTABLE_CONSTRUCTORS:
+                mutable = True
+            if mutable:
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = stmt
+    return out
+
+
+def _local_names(fn_node) -> set[str]:
+    """Parameters plus names stored anywhere inside the function."""
+    args = fn_node.args
+    names = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+    return names
+
+
+@rule(
+    "mutable-closure",
+    "jit-traced function closes over mutable host state (list/dict/set); "
+    "tracing captures it once — later mutations are invisible or unsound",
+)
+def _check_mutable_closure(ctx: ModuleContext):
+    if not ctx.traced_defs:
+        return
+    module_mutables = _mutable_bindings(ctx.tree)
+    # Enclosing-function locals: map each traced def to mutable bindings
+    # of every ancestor function scope.
+    enclosing: dict[int, dict[str, ast.AST]] = {}
+
+    def walk(node, inherited):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if ctx.is_traced_def(child):
+                    enclosing[id(child)] = dict(inherited)
+                walk(child, {**inherited, **_mutable_bindings(child)})
+            else:
+                walk(child, inherited)
+
+    walk(ctx.tree, {})
+    for fn in ctx.traced_defs:
+        candidates = {**module_mutables, **enclosing.get(id(fn), {})}
+        if not candidates:
+            continue
+        locals_ = _local_names(fn)
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in candidates
+                and node.id not in locals_
+            ):
+                yield ctx.finding(
+                    node,
+                    "mutable-closure",
+                    f"jitted `{fn.name}` reads enclosing mutable `{node.id}`; "
+                    "tracing freezes its current value — pass it as an "
+                    "argument or make it immutable",
+                )
+
+
+_HOST_SYNC_CALLS = frozenset({"float", "int", "bool", "print", "np.asarray", "np.array", "numpy.asarray", "numpy.array"})
+_HOST_SYNC_METHOD_TAILS = ("item", "tolist")
+
+
+@rule(
+    "host-sync-in-jit",
+    "host-synchronizing call (float()/.item()/np.asarray/print) inside a "
+    "jit-traced body — forces a device round-trip or fails under tracing",
+)
+def _check_host_sync(ctx: ModuleContext):
+    for fn in ctx.traced_defs:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname in _HOST_SYNC_CALLS:
+                yield ctx.finding(
+                    node,
+                    "host-sync-in-jit",
+                    f"`{fname}(...)` inside jitted `{fn.name}` forces a host "
+                    "sync (or breaks under tracing); compute on-device and "
+                    "convert outside the jitted region",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_SYNC_METHOD_TAILS
+                and not node.args
+            ):
+                yield ctx.finding(
+                    node,
+                    "host-sync-in-jit",
+                    f"`.{node.func.attr}()` inside jitted `{fn.name}` is a "
+                    "blocking device-to-host transfer",
+                )
+
+
+def _is_shapeish(expr, shape_locals) -> bool:
+    """x.shape / x.shape[0] / len(x) / a name bound to one of those."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "shape":
+        return True
+    if isinstance(expr, ast.Subscript):
+        return _is_shapeish(expr.value, shape_locals)
+    if isinstance(expr, ast.Call) and dotted(expr.func) == "len":
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id in shape_locals
+    return False
+
+
+@rule(
+    "nonstatic-shape-arg",
+    "shape-derived Python scalar flows into a jitted call that declares no "
+    "static_argnums — a per-size recompile hazard (pow2 bucket contract)",
+)
+def _check_nonstatic_shape_arg(ctx: ModuleContext):
+    if not ctx.jitted_callables:
+        return
+    scopes = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        # One linear pass: track names bound to shape-derived scalars,
+        # flag them (or direct .shape/len expressions) as jit args.
+        shape_locals: set[str] = set()
+        for node in scope_walk(scope):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.AST):
+                if _is_shapeish(node.value, shape_locals):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            shape_locals.add(tgt.id)
+        for node in scope_walk(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            info = ctx.jitted_callables.get(fname) if fname else None
+            if info is None or info.has_static:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if _is_shapeish(arg, shape_locals):
+                    yield ctx.finding(
+                        arg,
+                        "nonstatic-shape-arg",
+                        f"shape-derived scalar passed to jitted `{fname}` "
+                        "without static_argnums; batch sizes vary — route "
+                        "through the pow2 bucket contract or declare it "
+                        "static deliberately",
+                    )
+
+
+@rule(
+    "use-after-donate",
+    "a buffer passed in a donated position is used after the donating "
+    "call — on device it may alias freed or reused memory",
+)
+def _check_use_after_donate(ctx: ModuleContext):
+    donating = {
+        name: info
+        for name, info in ctx.jitted_callables.items()
+        if info.donate_argnums
+    }
+    if not donating:
+        return
+    scopes = [ctx.tree] + [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        yield from _donate_scan(ctx, scope, donating)
+
+
+def _stmt_children(stmt):
+    """Nested statement lists of a compound statement, in source order."""
+    for field in ("body", "orelse", "finalbody"):
+        yield from getattr(stmt, field, [])
+    for handler in getattr(stmt, "handlers", []):
+        yield from handler.body
+
+
+_STMT_LIST_FIELDS = ("body", "orelse", "finalbody", "handlers")
+
+
+def _stmt_expr_walk(stmt):
+    """Walk a statement's OWN expressions (test/items/iter/targets/value
+    ...), leaving nested statement lists to the recursive scan — so a
+    load inside a `with`/`if`/`for` body is seen exactly once, in
+    source order relative to the poisoning calls around it."""
+    roots = []
+    for field, value in ast.iter_fields(stmt):
+        if field in _STMT_LIST_FIELDS:
+            continue
+        if isinstance(value, ast.AST):
+            roots.append(value)
+        elif isinstance(value, list):
+            roots.extend(v for v in value if isinstance(v, ast.AST))
+    for root in roots:
+        yield root
+        yield from ast.walk(root)
+
+
+def _donate_scan(ctx, scope, donating):
+    poisoned: dict[str, str] = {}  # dotted name -> donating callee
+
+    def process(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue  # nested scopes get their own scan
+            # 1. loads of already-poisoned names (poison from earlier stmts)
+            if poisoned:
+                for node in _stmt_expr_walk(stmt):
+                    if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                        getattr(node, "ctx", None), ast.Load
+                    ):
+                        name = dotted(node)
+                        if name in poisoned:
+                            yield ctx.finding(
+                                node,
+                                "use-after-donate",
+                                f"`{name}` was donated to `{poisoned[name]}` "
+                                "and may alias freed device memory; rebind "
+                                "it to the call's result or stop donating",
+                            )
+            # 2. donating calls poison their donated args
+            for node in _stmt_expr_walk(stmt):
+                if isinstance(node, ast.Call):
+                    fname = dotted(node.func)
+                    info = donating.get(fname) if fname else None
+                    if info is None:
+                        continue
+                    for i in info.donate_argnums:
+                        if i < len(node.args):
+                            target_name = dotted(node.args[i])
+                            if target_name:
+                                poisoned[target_name] = fname
+            # 3. rebinding clears poison
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            elif isinstance(stmt, ast.Delete):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                targets = [stmt.target]
+            for tgt in targets:
+                for node in ast.walk(tgt):
+                    name = dotted(node)
+                    if name:
+                        poisoned.pop(name, None)
+            yield from process(_stmt_children(stmt))
+
+    yield from process(getattr(scope, "body", []))
+
+
+_TIMING_CALLS = frozenset(
+    {"time.perf_counter", "time.time", "time.monotonic", "perf_counter", "monotonic"}
+)
+
+
+@rule(
+    "timing-without-block",
+    "wall-clock measured across asynchronous JAX dispatch without "
+    "block_until_ready — the timer stops before the device finishes",
+)
+def _check_timing_without_block(ctx: ModuleContext):
+    for scope in [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]:
+        calls = [n for n in scope_walk(scope) if isinstance(n, ast.Call)]
+        timing = sorted(
+            (c for c in calls if dotted(c.func) in _TIMING_CALLS),
+            key=lambda c: (c.lineno, c.col_offset),
+        )
+        for first, second in zip(timing, timing[1:]):
+            region = [
+                c for c in calls if first.lineno < c.lineno < second.lineno
+            ]
+            has_block = any(
+                (dotted(c.func) or "").endswith("block_until_ready") for c in region
+            )
+            if has_block:
+                continue
+            for c in region:
+                fname = dotted(c.func) or ""
+                root = fname.split(".")[0]
+                if root in ("jax", "jnp") or fname in ctx.jitted_callables:
+                    yield ctx.finding(
+                        second,
+                        "timing-without-block",
+                        f"timed region dispatches `{fname}` asynchronously "
+                        "but never calls block_until_ready before reading "
+                        "the clock — the measurement excludes device time",
+                    )
+                    break
+
+
+_HOST_COMPUTE_OPS = frozenset(
+    {"argsort", "sort", "searchsorted", "bincount", "cumsum",
+     "concatenate", "unique", "nonzero", "where", "stack"}
+)
+
+
+@rule(
+    "jnp-on-host-path",
+    "device jnp compute op in a host-side NumPy ingest path — pays "
+    "dispatch overhead and device round-trips where np is correct",
+)
+def _check_jnp_on_host_path(ctx: ModuleContext):
+    for scope in [
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and not ctx.is_traced_def(n)
+    ]:
+        calls = [n for n in scope_walk(scope) if isinstance(n, ast.Call)]
+        uses_numpy = any(
+            (dotted(c.func) or "").split(".")[0] in ("np", "numpy") for c in calls
+        )
+        if not uses_numpy:
+            continue
+        for c in calls:
+            fname = dotted(c.func) or ""
+            parts = fname.split(".")
+            if (
+                len(parts) == 2
+                and parts[0] in ("jnp", "jax.numpy")
+                and parts[1] in _HOST_COMPUTE_OPS
+            ):
+                yield ctx.finding(
+                    c,
+                    "jnp-on-host-path",
+                    f"`{fname}` in host-side `{scope.name}` runs on device; "
+                    "this is a NumPy ingest path — use "
+                    f"`np.{parts[1]}` (see engine.pack_batch)",
+                )
+
+
+# --- driver ---------------------------------------------------------------
+
+BADCORPUS_DIR = "badcorpus"
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Finding]:
+    """Lint one module's source; returns findings after suppression."""
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, exc.offset or 0, "syntax-error", str(exc))]
+    findings = []
+    for r in RULES.values():
+        findings.extend(r.check(ctx))
+    kept = []
+    for f in findings:
+        disabled = ctx.suppressions.get(f.line, set())
+        if "all" in disabled or f.rule in disabled:
+            continue
+        kept.append(f)
+    return sorted(kept, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def iter_python_files(paths):
+    """Expand files/dirs into .py files. Directory walks skip the
+    embedded bad-example corpus (and __pycache__) unless the given root
+    itself points into the corpus — so `jaxlint arena/` is clean while
+    `jaxlint arena/analysis/badcorpus` lints the corpus."""
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if p.is_file():
+            yield p
+        elif p.is_dir():
+            inside_corpus = BADCORPUS_DIR in p.resolve().parts
+            for f in sorted(p.rglob("*.py")):
+                rel_parts = f.resolve().parts
+                if "__pycache__" in rel_parts:
+                    continue
+                if not inside_corpus and BADCORPUS_DIR in rel_parts:
+                    continue
+                yield f
+        else:
+            raise FileNotFoundError(f"no such file or directory: {raw}")
+
+
+def lint_paths(paths) -> list[Finding]:
+    findings = []
+    for f in iter_python_files(paths):
+        findings.extend(lint_source(f.read_text(), str(f)))
+    return findings
+
+
+def default_targets() -> list[str]:
+    """The repo surfaces the tier-1 gate lints: arena/, bench.py, tests/."""
+    repo = pathlib.Path(__file__).resolve().parent.parent.parent
+    return [str(repo / "arena"), str(repo / "bench.py"), str(repo / "tests")]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m arena.analysis",
+        description="JAX-aware lint rules guarding the arena hot path",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the repo's arena/, "
+        "bench.py, tests/)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule registry and exit"
+    )
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.name}: {r.summary}")
+        return 0
+    targets = args.paths or default_targets()
+    try:
+        findings = lint_paths(targets)
+    except FileNotFoundError as exc:
+        print(f"jaxlint: {exc}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+    print(
+        f"jaxlint: {len(findings)} finding(s) over {len(RULES)} rule(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

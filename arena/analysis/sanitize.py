@@ -1,0 +1,160 @@
+"""Opt-in runtime sanitizers for the arena hot path.
+
+The static rules in `arena.analysis.jaxlint` catch what is visible in
+source; these catch what only shows up at runtime, and they make the
+failure LOUD in tests instead of a silently wrong number in production:
+
+- `checked()` — context manager wiring `jax_debug_nans` and
+  `jax_debug_infs` on (restored on exit), so a NaN/Inf produced inside
+  a rating update raises `FloatingPointError` at the op that made it.
+- `RecompileSentinel` — snapshots jit-cache sizes after warmup and
+  asserts zero new compiles afterwards. This is the runtime half of the
+  engine's pow2 shape-bucket contract: arena traffic with arbitrary
+  batch sizes must NEVER grow the jit cache past the buckets it
+  touched during warmup.
+- `donation_guard` — wraps a donating jitted callable and explicitly
+  deletes the donated argument buffers after every call. When donation
+  works (CPU/TPU honoring donate_argnums) this is a no-op; when it
+  silently does NOT (shape/dtype mismatch makes XLA skip donation with
+  only a warning), reuse of the stale buffer would return garbage-free
+  but semantically-wrong results — the guard turns that reuse into an
+  immediate `RuntimeError: Array has been deleted`.
+
+Everything here imports jax; the linter half of this package does not.
+Keep it that way — lint must run on boxes with no accelerator stack.
+"""
+
+import functools
+from contextlib import contextmanager
+
+import jax
+
+# The config knobs checked() owns. Values are read/restored via
+# jax.config attributes (stable across the 0.4.x line pinned here).
+_DEBUG_FLAGS = ("jax_debug_nans", "jax_debug_infs")
+
+
+class SanitizerError(AssertionError):
+    """Base class: a sanitizer invariant was violated."""
+
+
+class RecompileError(SanitizerError):
+    """The zero-new-compiles-after-warmup contract was broken."""
+
+
+@contextmanager
+def checked(debug_nans=True, debug_infs=True):
+    """Run a block with NaN/Inf debugging on; restore flags on exit.
+
+    Inside the block, any op producing a NaN (and, with `debug_infs`,
+    an Inf) raises `FloatingPointError` immediately — eager or jitted.
+    Note jitted functions compile a checked variant while the flag is
+    on (the flag is part of the compilation context), so do not combine
+    with a `RecompileSentinel` snapshot taken OUTSIDE the block.
+    """
+    old = {flag: getattr(jax.config, flag) for flag in _DEBUG_FLAGS}
+    jax.config.update("jax_debug_nans", debug_nans)
+    jax.config.update("jax_debug_infs", debug_infs)
+    try:
+        yield
+    finally:
+        for flag, value in old.items():
+            jax.config.update(flag, value)
+
+
+def _cache_count(watched) -> int:
+    """Compile count of one watched object: a jitted callable (has
+    `_cache_size`) or any zero-arg callable returning an int (e.g.
+    `ArenaEngine.num_compiles`)."""
+    cache_size = getattr(watched, "_cache_size", None)
+    if cache_size is not None:
+        return int(cache_size())
+    if callable(watched):
+        return int(watched())
+    raise TypeError(
+        f"cannot watch {watched!r}: need a jitted callable or a zero-arg "
+        "compile-count callable"
+    )
+
+
+class RecompileSentinel:
+    """Assert zero new XLA compiles between snapshot and check.
+
+    Construction snapshots — so warm the watched functions up FIRST,
+    then build the sentinel, then drive the traffic under test:
+
+        eng = ArenaEngine(1000)
+        eng.update(w, l)                      # warmup: compiles bucket
+        sentinel = RecompileSentinel(update=eng.num_compiles)
+        ... arbitrary batch sizes ...
+        sentinel.assert_no_new_compiles()     # raises RecompileError
+
+    Also usable as a context manager (`with RecompileSentinel(...)`):
+    enter re-snapshots, exit checks.
+    """
+
+    def __init__(self, **watched):
+        if not watched:
+            raise ValueError("nothing to watch")
+        self._watched = watched
+        self.snapshot()
+
+    def snapshot(self):
+        self._baseline = {k: _cache_count(v) for k, v in self._watched.items()}
+
+    def new_compiles(self) -> dict:
+        """name -> (baseline, now) for every watched fn that recompiled."""
+        out = {}
+        for name, obj in self._watched.items():
+            now = _cache_count(obj)
+            before = self._baseline[name]
+            if now != before:
+                out[name] = (before, now)
+        return out
+
+    def assert_no_new_compiles(self):
+        grew = self.new_compiles()
+        if grew:
+            detail = ", ".join(
+                f"{name}: {before} -> {now} compiles"
+                for name, (before, now) in grew.items()
+            )
+            raise RecompileError(
+                f"jit cache grew after warmup ({detail}); the shape-bucket "
+                "contract promises zero recompiles — an unbucketed shape or "
+                "dtype is leaking into a jitted signature"
+            )
+
+    def __enter__(self):
+        self.snapshot()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.assert_no_new_compiles()
+        return False
+
+
+def donation_guard(fn, donate_argnums=(0,)):
+    """Wrap a donating callable so reuse-after-donate fails loudly.
+
+    After every call, each positional argument named in `donate_argnums`
+    that is a live `jax.Array` is explicitly deleted. If the wrapped
+    function's own donation already consumed the buffer (the healthy
+    case) this does nothing; if donation was silently skipped, the
+    buffer dies here instead of lingering as a stale alias — and any
+    later use raises `RuntimeError: Array has been deleted`.
+    """
+
+    @functools.wraps(fn)
+    def guarded(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        for i in donate_argnums:
+            if i >= len(args):
+                continue
+            arg = args[i]
+            if isinstance(arg, jax.Array) and not arg.is_deleted():
+                arg.delete()
+        return out
+
+    return guarded

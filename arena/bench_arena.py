@@ -27,13 +27,20 @@ Bradley–Terry ground truth, so the workload is reproducible):
   1-core image extra host devices share one core.
 
 The two paths' final ratings are compared BEFORE any speedup is
-reported (``equivalence_ok`` rides in the line; a speedup over code
-computing something different would be fiction).
+reported — and the comparison is a HARD GATE, not an annotation: if
+``max_diff`` exceeds the tolerance, no speedup is computed at all, the
+one JSON line carries the distinct ``arena_bench_equivalence_failure``
+metric, and the process exits rc 2 (a measured divergence verdict —
+distinct from rc 0's in-contract internal-error degradation and from
+rc 1, which stays reserved for an unwritable stdout). A speedup over
+code computing something different would be fiction, so it is now
+impossible to emit one.
 
 Env knobs (all optional): ARENA_BENCH_MATCHES (100000),
 ARENA_BENCH_PLAYERS (1000), ARENA_BENCH_BATCH (8192),
 ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED (0), ARENA_BENCH_BT_ITERS
-(25), ARENA_BENCH_DEVICES (unset — forces a host CPU device count for
+(25), ARENA_BENCH_TOL (0.5 rating points — the equivalence gate),
+ARENA_BENCH_DEVICES (unset — forces a host CPU device count for
 the sharded path when the backend is not yet initialized).
 """
 
@@ -69,6 +76,24 @@ from arena import baseline, engine, ratings, sharding  # noqa: E402
 # (measured ~2e-4 at the default size; budget leaves room for bigger
 # runs without letting a real divergence through).
 EQUIVALENCE_TOL = 0.5
+
+# Exit codes: 0 = measured (or in-contract internal-error line),
+# 1 = stdout unwritable (no JSON line possible), 2 = the two paths
+# DIVERGED beyond tolerance — a measured verdict, never conflated
+# with a crash (same discipline as the gate's rc 3/rc 4 split).
+EXIT_EQUIVALENCE_FAILURE = 2
+
+
+class EquivalenceError(AssertionError):
+    """The naive and vectorized paths disagree beyond tolerance."""
+
+    def __init__(self, max_diff, tol):
+        super().__init__(
+            f"max |rating diff| {max_diff:.6g} exceeds tolerance {tol:g}; "
+            "no speedup may be reported over a divergent computation"
+        )
+        self.max_diff = max_diff
+        self.tol = tol
 
 
 def _env_int(name, default):
@@ -131,7 +156,12 @@ def run_benchmark():
     )
 
     max_diff = float(np.abs(np.asarray(jit_ratings) - naive_ratings).max())
-    equivalence_ok = max_diff < EQUIVALENCE_TOL
+    tol = float(os.environ.get("ARENA_BENCH_TOL", EQUIVALENCE_TOL))
+    equivalence_ok = max_diff < tol
+    if not equivalence_ok:
+        # Hard gate: nothing below (speedup, BT, sharded numbers) is
+        # computed or reported over a divergent pair of paths.
+        raise EquivalenceError(max_diff, tol)
     speedup = naive_epoch_s / jit_epoch_s
 
     # --- Bradley–Terry: per-MM-iteration, naive vs fused -------------
@@ -213,8 +243,25 @@ def run_benchmark():
 
 
 def main() -> int:
+    rc = 0
     try:
         line = json.dumps(run_benchmark())
+    except EquivalenceError as exc:
+        # A measured verdict, not a crash: the paths diverged, so the
+        # line carries the divergence instead of a speedup and the
+        # process exits the distinct equivalence-failure code.
+        line = json.dumps(
+            {
+                "metric": "arena_bench_equivalence_failure",
+                "value": -1,
+                "unit": "x_vs_naive_baseline",
+                "vs_baseline": None,
+                "max_rating_diff": round(exc.max_diff, 6),
+                "tolerance": exc.tol,
+                "error": str(exc),
+            }
+        )
+        rc = EXIT_EQUIVALENCE_FAILURE
     except Exception as exc:  # noqa: BLE001 — the one-line contract outranks
         line = json.dumps(
             {
@@ -230,7 +277,7 @@ def main() -> int:
     try:
         print(line)
         sys.stdout.flush()
-        return 0
+        return rc
     except Exception:  # noqa: BLE001 — stdout itself is broken
         return 1
 

@@ -69,6 +69,24 @@ class PackedBatch(NamedTuple):
     num_real: int
 
 
+def _validate_matches(num_players, winners, losers):
+    """Reject malformed outcome arrays BEFORE they reach the packed
+    layout. An out-of-range id would not crash downstream — the
+    counting-sort grouping and the masked scatter would silently fold
+    the bogus update into padded slots or neighboring players — so the
+    only honest failure point is ingest."""
+    if winners.shape != losers.shape or winners.ndim != 1:
+        raise ValueError("winners/losers must be 1-D arrays of equal length")
+    if winners.size:
+        lo = int(min(winners.min(), losers.min()))
+        hi = int(max(winners.max(), losers.max()))
+        if lo < 0 or hi >= num_players:
+            raise ValueError(
+                f"player ids must be in [0, {num_players}); got range "
+                f"[{lo}, {hi}]"
+            )
+
+
 def _group_by_player(combined, num_players):
     """Counting-sort grouping of a combined index array (host NumPy)."""
     order = np.argsort(combined, kind="stable").astype(np.int32)
@@ -82,8 +100,7 @@ def pack_batch(num_players, winners, losers, min_bucket=MIN_BUCKET, dtype=np.flo
     """Pad one match batch to its bucket and precompute its grouping."""
     winners = np.asarray(winners, dtype=np.int32)
     losers = np.asarray(losers, dtype=np.int32)
-    if winners.shape != losers.shape or winners.ndim != 1:
-        raise ValueError("winners/losers must be 1-D arrays of equal length")
+    _validate_matches(num_players, winners, losers)
     n = winners.shape[0]
     b = bucket_size(n, min_bucket)
     pad = b - n
@@ -117,6 +134,7 @@ def pack_epoch(num_players, winners, losers, batch_size, dtype=np.float32):
     """
     winners = np.asarray(winners, dtype=np.int32)
     losers = np.asarray(losers, dtype=np.int32)
+    _validate_matches(num_players, winners, losers)
     n = winners.shape[0]
     if n == 0:
         raise ValueError("cannot pack an empty match set")

@@ -226,6 +226,26 @@ MUTATIONS = (
         '            "metric": "non_graftable_reference_is_empty",\n            "value": 0,',
         "a bench crash must degrade to a visible error metric, never an authoritative empty-tree report",
     ),
+    (
+        "lint-host-sync-rule-blinded",
+        "arena/analysis/jaxlint.py",
+        '_HOST_SYNC_CALLS = frozenset({"float", "int", "bool", "print", "np.asarray", "np.array", "numpy.asarray", "numpy.array"})',
+        "_HOST_SYNC_CALLS = frozenset()",
+        "the host-sync lint rule must flag device round-trips inside jitted "
+        "bodies; an emptied call set voids the hot-path protection while the "
+        "linter still reports success — the corpus test must catch it",
+    ),
+    (
+        "lint-donation-poisoning-dropped",
+        "arena/analysis/jaxlint.py",
+        "                            if target_name:\n"
+        "                                poisoned[target_name] = fname",
+        "                            if target_name:\n"
+        "                                pass",
+        "the use-after-donate rule must track buffers through donating "
+        "calls; dropping the poisoning step makes every reuse-after-donate "
+        "invisible — the corpus test must catch it",
+    ),
 )
 
 

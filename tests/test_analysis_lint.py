@@ -1,0 +1,181 @@
+"""jaxlint contracts: clean tree lints clean, every rule fires on the
+corpus, suppressions work, and the CLI honors its exit codes.
+
+The clean-tree assertion is the CI wiring the tentpole asks for: the
+linter runs over `arena/`, `bench.py`, and `tests/` inside tier-1, so
+any commit that introduces a hot-path hazard (host sync in a jitted
+body, use-after-donate, unblocked timing, ...) turns the suite red in
+the same commit. Most checks run in-process (the linter is stdlib-only
+and parses the repo in milliseconds); exactly one subprocess pins the
+real `python -m arena.analysis` entrypoint because that is the
+documented operator command.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from arena.analysis import jaxlint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = REPO / "arena" / "analysis" / "badcorpus"
+CLEAN_TARGETS = [str(REPO / "arena"), str(REPO / "bench.py"), str(REPO / "tests")]
+
+# Per-file contract: each corpus module trips EXACTLY its own rule.
+# (Asserting set equality, not membership, keeps corpus files honest —
+# a file that started tripping a second rule means either the file or
+# a rule drifted.)
+CORPUS_EXPECTED = {
+    "bad_mutable_closure.py": {"mutable-closure"},
+    "bad_host_sync.py": {"host-sync-in-jit"},
+    "bad_nonstatic_shape.py": {"nonstatic-shape-arg"},
+    "bad_use_after_donate.py": {"use-after-donate"},
+    "bad_timing.py": {"timing-without-block"},
+    "bad_jnp_host.py": {"jnp-on-host-path"},
+}
+
+
+def test_clean_tree_has_zero_findings():
+    """The repo's own hot path obeys every invariant the linter checks.
+    A finding here is a real regression (or a new rule that needs
+    tuning/suppression) — fix it, don't relax this test."""
+    findings = jaxlint.lint_paths(CLEAN_TARGETS)
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_every_rule_fires_on_the_corpus():
+    findings = jaxlint.lint_paths([str(CORPUS)])
+    fired = {f.rule for f in findings}
+    assert fired == set(jaxlint.RULES), (
+        f"rules never exercised by the corpus: {set(jaxlint.RULES) - fired}"
+    )
+
+
+def test_each_corpus_file_trips_exactly_its_rule():
+    # The manifest must cover every corpus file and every rule.
+    files = {p.name for p in CORPUS.glob("bad_*.py")}
+    assert files == set(CORPUS_EXPECTED)
+    for name, expected in CORPUS_EXPECTED.items():
+        found = {f.rule for f in jaxlint.lint_paths([str(CORPUS / name)])}
+        assert found == expected, f"{name}: found {found}, expected {expected}"
+
+
+def test_host_sync_rule_names_each_call_form():
+    """Both halves of the rule must fire: the named-callable set
+    (print/float/np.asarray — the half a blinded flag set would drop)
+    AND the .item() method branch. Membership per call form, not just
+    per rule, so neither half can silently rot."""
+    findings = jaxlint.lint_paths([str(CORPUS / "bad_host_sync.py")])
+    messages = "\n".join(f.message for f in findings)
+    for call_form in ("`print(...)`", "`float(...)`", "`np.asarray(...)`", ".item()"):
+        assert call_form in messages, f"host-sync rule no longer flags {call_form}"
+
+
+def test_default_walk_skips_the_corpus():
+    """`jaxlint arena/` must not see badcorpus/ (clean tree stays
+    clean) while linting the corpus dir explicitly must."""
+    over_arena = jaxlint.lint_paths([str(REPO / "arena")])
+    assert all("badcorpus" not in f.path for f in over_arena)
+    assert jaxlint.lint_paths([str(CORPUS)]) != []
+
+
+def test_inline_suppression_mutes_only_the_named_rule():
+    bad = (CORPUS / "bad_timing.py").read_text()
+    assert jaxlint.lint_source(bad, "t.py") != []
+    muted = bad.replace(
+        "elapsed = time.perf_counter() - t0",
+        "elapsed = time.perf_counter() - t0  # jaxlint: disable=timing-without-block",
+    )
+    assert jaxlint.lint_source(muted, "t.py") == []
+    wrong_rule = bad.replace(
+        "elapsed = time.perf_counter() - t0",
+        "elapsed = time.perf_counter() - t0  # jaxlint: disable=mutable-closure",
+    )
+    assert jaxlint.lint_source(wrong_rule, "t.py") != []
+    mute_all = bad.replace(
+        "elapsed = time.perf_counter() - t0",
+        "elapsed = time.perf_counter() - t0  # jaxlint: disable=all",
+    )
+    assert jaxlint.lint_source(mute_all, "t.py") == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = jaxlint.lint_source("def broken(:\n", "b.py")
+    assert len(findings) == 1
+    assert findings[0].rule == "syntax-error"
+
+
+def test_main_in_process_exit_codes():
+    assert jaxlint.main(CLEAN_TARGETS) == 0
+    assert jaxlint.main([str(CORPUS)]) == 1
+    assert jaxlint.main([str(REPO / "does-not-exist")]) == 2
+    assert jaxlint.main(["--list-rules"]) == 0
+
+
+def test_findings_name_real_lines(capsys):
+    """CLI output is path:line:col: rule: message — clickable and
+    stable enough for CI grepping."""
+    rc = jaxlint.main([str(CORPUS / "bad_use_after_donate.py")])
+    assert rc == 1
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    path, line, _col, rule_name = out[0].split(":", 3)
+    assert path.endswith("bad_use_after_donate.py")
+    src_line = (CORPUS / "bad_use_after_donate.py").read_text().splitlines()[
+        int(line) - 1
+    ]
+    assert "state" in src_line
+    assert rule_name.strip().startswith("use-after-donate")
+
+
+@pytest.mark.parametrize("good", [
+    # Rebinding to the donating call's result is the sanctioned pattern.
+    "import jax\n"
+    "f = jax.jit(lambda s, d: s + d, donate_argnums=(0,))\n"
+    "def ok(state, delta):\n"
+    "    state = f(state, delta)\n"
+    "    return state + 1.0\n",
+    # Timing with block_until_ready in the region is honest.
+    "import time\nimport jax\nimport jax.numpy as jnp\n"
+    "def ok(x):\n"
+    "    t0 = time.perf_counter()\n"
+    "    y = jax.block_until_ready(jnp.dot(x, x))\n"
+    "    return y, time.perf_counter() - t0\n",
+    # jnp compute in a TRACED body is the correct placement.
+    "import jax\nimport jax.numpy as jnp\nimport numpy as np\n"
+    "@jax.jit\n"
+    "def ok(x):\n"
+    "    return jnp.cumsum(x)\n"
+    "def host(x):\n"
+    "    return np.asarray(ok(jnp.asarray(x)))\n",
+    # static_argnums declared: the shape arg is deliberate.
+    "import jax\n"
+    "f = jax.jit(lambda x, n: x, static_argnums=(1,))\n"
+    "def ok(batch):\n"
+    "    return f(batch, batch.shape[0])\n",
+])
+def test_sanctioned_patterns_lint_clean(good):
+    assert jaxlint.lint_source(good, "ok.py") == []
+
+
+def test_cli_subprocess_contract():
+    """The documented operator command, end to end: the acceptance
+    criterion's clean run (rc 0, empty stdout) and the corpus run
+    (rc 1, findings on stdout). Two plain-`python` spawns (~1.7s each
+    on this image — `-S` is not an option here because `-m
+    arena.analysis` imports the arena package, whose __init__ pulls
+    jax from site-packages)."""
+    clean = subprocess.run(
+        [sys.executable, "-m", "arena.analysis", "arena/", "bench.py"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert clean.stdout.strip() == ""
+    corpus = subprocess.run(
+        [sys.executable, "-m", "arena.analysis", "arena/analysis/badcorpus"],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert corpus.returncode == 1
+    assert "use-after-donate" in corpus.stdout

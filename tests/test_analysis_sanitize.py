@@ -1,0 +1,169 @@
+"""Runtime-sanitizer contracts (arena/analysis/sanitize.py).
+
+Three sanitizers, each tested in both directions — it passes on the
+engine's sanctioned patterns AND it catches the exact failure it
+exists for:
+
+- recompile sentinel: zero new compiles over `ArenaEngine` across
+  varying batch sizes (the acceptance criterion), and a loud
+  `RecompileError` on an unbucketed jit fed varying shapes;
+- donation guard: `jit_elo_epoch(donate=True)` under the guard makes a
+  deliberate reuse-after-donate raise instead of silently reading a
+  stale buffer — and the guard deletes the buffer ITSELF when the
+  wrapped function does not donate (the silent-skip case it exists for);
+- checked(): a NaN raises FloatingPointError inside the block, flags
+  restored after.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from arena import engine
+from arena import ratings as R
+from arena.analysis import sanitize
+from arena.engine import ArenaEngine
+
+
+def feed(eng, n, seed):
+    rng = np.random.default_rng(seed)
+    w = rng.integers(0, eng.num_players, n).astype(np.int32)
+    l = ((w + 1 + rng.integers(0, eng.num_players - 1, n)) % eng.num_players).astype(
+        np.int32
+    )
+    eng.update(w, l)
+
+
+def test_recompile_sentinel_passes_over_bucketed_engine():
+    """The acceptance criterion: after warmup, arbitrary batch sizes
+    within the touched buckets add ZERO jit-cache entries — asserted
+    through the sanitizer, not the raw cache stats."""
+    eng = ArenaEngine(48)
+    feed(eng, 10, seed=0)  # warmup: compiles the floor bucket
+    sentinel = sanitize.RecompileSentinel(update=eng.num_compiles)
+    for i, n in enumerate((1, 7, 100, 255, engine.MIN_BUCKET)):
+        feed(eng, n, seed=i + 1)
+    sentinel.assert_no_new_compiles()  # must not raise
+    assert sentinel.new_compiles() == {}
+
+
+def test_recompile_sentinel_catches_unbucketed_jit():
+    """The failure the bucketing contract forbids: raw varying shapes
+    into a jit make the cache grow per size; the sentinel names the
+    function and the growth."""
+    f = jax.jit(lambda x: x * 2.0)
+    f(jnp.zeros(3))  # warmup
+    sentinel = sanitize.RecompileSentinel(unbucketed=f)
+    f(jnp.zeros(5))  # new shape -> new compile
+    with pytest.raises(sanitize.RecompileError, match="unbucketed: 1 -> 2"):
+        sentinel.assert_no_new_compiles()
+    assert sentinel.new_compiles() == {"unbucketed": (1, 2)}
+
+
+def test_recompile_sentinel_context_manager_form():
+    f = jax.jit(lambda x: x + 1.0)
+    f(jnp.zeros(4))
+    with sanitize.RecompileSentinel(f=f):
+        f(jnp.ones(4))  # same shape: cached
+    with pytest.raises(sanitize.RecompileError):
+        with sanitize.RecompileSentinel(f=f):
+            f(jnp.zeros(6))
+
+
+def test_recompile_sentinel_rejects_unwatchable_and_empty():
+    with pytest.raises(ValueError):
+        sanitize.RecompileSentinel()
+    with pytest.raises(TypeError):
+        sanitize.RecompileSentinel(x=object())
+
+
+def test_donation_guard_catches_reuse_after_donate():
+    """The satellite: the real donating epoch under the sanitizer. The
+    deliberate reuse below is exactly what jaxlint's use-after-donate
+    rule forbids, hence the inline suppressions — the lint rule and the
+    runtime guard are two halves of one invariant."""
+    num_players = 16
+    rng = np.random.default_rng(3)
+    w = rng.integers(0, num_players, 500).astype(np.int32)
+    l = ((w + 1 + rng.integers(0, num_players - 1, 500)) % num_players).astype(
+        np.int32
+    )
+    packed = engine.pack_epoch(num_players, w, l, batch_size=256)
+    with sanitize.checked():
+        epoch = sanitize.donation_guard(
+            R.jit_elo_epoch(num_players, donate=True), donate_argnums=(0,)
+        )
+        r = jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32)
+        out = epoch(
+            r, packed.winners, packed.losers, packed.valid, packed.perms,
+            packed.bounds,
+        )
+        assert not out.is_deleted()
+        assert r.is_deleted()  # jaxlint: disable=use-after-donate
+        with pytest.raises(RuntimeError, match="deleted"):
+            _ = r + 1.0  # jaxlint: disable=use-after-donate
+
+
+def test_donation_guard_deletes_when_wrapped_fn_does_not_donate():
+    """The silent-skip case the guard exists for: the wrapped function
+    did NOT donate (donate=False stands in for XLA skipping donation
+    with only a warning), so the stale input would survive as a
+    readable alias — the guard kills it anyway."""
+    num_players = 8
+    packed = engine.pack_epoch(
+        num_players, [1, 2, 3], [4, 5, 6], batch_size=256
+    )
+    epoch = sanitize.donation_guard(
+        R.jit_elo_epoch(num_players, donate=False), donate_argnums=(0,)
+    )
+    r = jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32)
+    epoch(
+        r, packed.winners, packed.losers, packed.valid, packed.perms,
+        packed.bounds,
+    )
+    assert r.is_deleted()  # jaxlint: disable=use-after-donate
+
+
+def test_donation_guard_preserves_output_and_semantics():
+    """Guarded and unguarded calls compute the same ratings."""
+    num_players = 12
+    packed = engine.pack_epoch(
+        num_players, [0, 1, 2, 3], [4, 5, 6, 7], batch_size=256
+    )
+    args = (packed.winners, packed.losers, packed.valid, packed.perms, packed.bounds)
+    r0 = jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32)
+    want = R.jit_elo_epoch(num_players, donate=False)(r0, *args)
+    guarded = sanitize.donation_guard(R.jit_elo_epoch(num_players, donate=True))
+    got = guarded(jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32), *args)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_checked_raises_on_nan_and_restores_flags():
+    assert not jax.config.jax_debug_nans
+    with sanitize.checked():
+        assert jax.config.jax_debug_nans and jax.config.jax_debug_infs
+        with pytest.raises(FloatingPointError):
+            jnp.log(jnp.float32(-1.0))
+    assert not jax.config.jax_debug_nans
+    assert not jax.config.jax_debug_infs
+    # Outside the block the same op is NaN-silent again.
+    assert np.isnan(float(jnp.log(jnp.float32(-1.0))))
+
+
+def test_checked_restores_flags_even_when_body_raises():
+    with pytest.raises(RuntimeError, match="boom"):
+        with sanitize.checked():
+            raise RuntimeError("boom")
+    assert not jax.config.jax_debug_nans
+    assert not jax.config.jax_debug_infs
+
+
+def test_checked_engine_epoch_is_nan_free():
+    """The sanitizer in its intended posture: a healthy engine pass
+    runs clean under full NaN/Inf checking."""
+    eng = ArenaEngine(10)
+    with sanitize.checked():
+        feed(eng, 64, seed=7)
+    assert np.isfinite(np.asarray(eng.ratings)).all()

@@ -25,7 +25,7 @@ CONTRACT_KEYS = {
 }
 
 
-def run_bench(env_overrides, timeout=240):
+def run_bench(env_overrides, timeout=240, expect_rc=0):
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     env.update(env_overrides)
     proc = subprocess.run(
@@ -36,7 +36,7 @@ def run_bench(env_overrides, timeout=240):
         cwd="/tmp",  # must work from any cwd
         timeout=timeout,
     )
-    assert proc.returncode == 0, proc.stderr
+    assert proc.returncode == expect_rc, (proc.returncode, proc.stderr)
     lines = proc.stdout.splitlines()
     assert len(lines) == 1, f"expected ONE json line, got: {proc.stdout!r}"
     return json.loads(lines[0])
@@ -90,6 +90,30 @@ def test_bench_full_size_hits_50x_with_sharded_path():
     assert result["value"] >= 50.0, f"speedup regressed: {result['value']}x"
     assert result["sharded"]["devices"] == 2
     assert result["sharded"]["matches_per_s"] > 0
+
+
+def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
+    """The hard gate: with the tolerance forced to 0 the (real, tiny)
+    float32-vs-float64 divergence trips it — one JSON line carrying the
+    distinct equivalence metric, NO speedup fields, and rc 2 (a
+    measured-divergence verdict, not a crash and not rc 0)."""
+    result = run_bench(
+        {
+            "ARENA_BENCH_MATCHES": "2000",
+            "ARENA_BENCH_PLAYERS": "64",
+            "ARENA_BENCH_BATCH": "512",
+            "ARENA_BENCH_REPEATS": "1",
+            "ARENA_BENCH_TOL": "0",
+        },
+        expect_rc=2,
+    )
+    assert result["metric"] == "arena_bench_equivalence_failure"
+    assert result["value"] == -1
+    assert result["tolerance"] == 0.0
+    assert result["max_rating_diff"] >= 0.0
+    assert "exceeds tolerance" in result["error"]
+    # The line must not smuggle a speedup or per-path timings along.
+    assert "elo" not in result and "bt" not in result and "sharded" not in result
 
 
 def test_bench_internal_error_degrades_to_error_line():

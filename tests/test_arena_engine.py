@@ -48,9 +48,51 @@ def test_pack_batch_rejects_ragged_input():
         engine.pack_batch(10, [1, 2], [3])
 
 
+def test_pack_batch_rejects_out_of_range_ids():
+    """An out-of-range id would not crash downstream — the grouping
+    would silently scatter the bogus update into padded slots or a
+    neighboring player — so ingest must refuse it loudly."""
+    with pytest.raises(ValueError, match=r"player ids must be in \[0, 10\)"):
+        engine.pack_batch(10, [1, 10], [2, 3])  # == num_players
+    with pytest.raises(ValueError, match="player ids"):
+        engine.pack_batch(10, [1, 2], [-1, 3])  # negative
+    # The boundary ids themselves are fine.
+    packed = engine.pack_batch(10, [0, 9], [9, 0])
+    assert packed.num_real == 2
+
+
+def test_pack_batch_rejects_non_1d():
+    with pytest.raises(ValueError, match="1-D"):
+        engine.pack_batch(10, [[1, 2]], [[3, 4]])
+
+
 def test_pack_epoch_rejects_empty():
     with pytest.raises(ValueError):
         engine.pack_epoch(10, [], [], batch_size=256)
+
+
+def test_pack_epoch_rejects_out_of_range_and_ragged():
+    """pack_epoch builds its grouping without pack_batch, so it must
+    run the same ingest validation."""
+    with pytest.raises(ValueError, match="player ids"):
+        engine.pack_epoch(10, [1, 99], [2, 3], batch_size=256)
+    with pytest.raises(ValueError, match="player ids"):
+        engine.pack_epoch(10, [1, 2], [-5, 3], batch_size=256)
+    with pytest.raises(ValueError, match="equal length"):
+        engine.pack_epoch(10, [1, 2], [3], batch_size=256)
+
+
+def test_engine_update_rejects_out_of_range_ids_without_state_change():
+    """A rejected batch must not half-ingest: ratings, history, and the
+    match counter all stay untouched."""
+    eng = ArenaEngine(8)
+    before = np.asarray(eng.ratings).copy()
+    with pytest.raises(ValueError, match="player ids"):
+        eng.update([0, 8], [1, 2])
+    np.testing.assert_array_equal(np.asarray(eng.ratings), before)
+    assert eng.matches_ingested == 0
+    with pytest.raises(ValueError, match="no matches ingested"):
+        eng.bt_strengths()
 
 
 def test_padded_update_equals_unpadded():

@@ -52,9 +52,15 @@ def test_docs_cite_the_live_mutant_count():
     assert f"current {n} mutants" in skill
 
 
-def test_mutations_cover_both_runtime_surfaces():
+def test_mutations_cover_every_policed_surface():
+    """bench + gate (the honesty machinery) and jaxlint (the lint rules
+    whose corpus test is itself a policed property since PR 2)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
-    assert files == {"bench.py", "verify_reference.py"}
+    assert files == {
+        "bench.py",
+        "verify_reference.py",
+        "arena/analysis/jaxlint.py",
+    }
 
 
 def test_copied_set_exists_and_excludes_git():
@@ -73,10 +79,12 @@ def _FakeProc(returncode, stdout=""):
 
 
 def _fake_sources_only(dest):
-    """Stand-in for make_copy: just the two mutable sources, so the
+    """Stand-in for make_copy: just the mutable sources, so the
     mutation patterns resolve without dragging the whole tree along."""
-    for name in ("bench.py", "verify_reference.py"):
-        shutil.copy2(mutation_audit.REPO / name, dest / name)
+    for name in ("bench.py", "verify_reference.py", "arena/analysis/jaxlint.py"):
+        target = dest / name
+        target.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy2(mutation_audit.REPO / name, target)
 
 
 def _audit_json(capsys):
