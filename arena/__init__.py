@@ -9,6 +9,9 @@ Modules:
   Bradley–Terry MLE, the scatter-free sorted segment sum.
 - `arena.engine`   — ingestion (CSR-style grouping), shape-bucketed
   batching, the stateful `ArenaEngine` with jitted donated updates.
+- `arena.ingest`   — incremental ingestion: the mergeable whole-set
+  CSR grouping (delta-sorted tail + galloping merge), double-buffered
+  reusable staging slots, and the chunked epoch layout for BT refits.
 - `arena.sharding` — device mesh, partition-rule matching, shard_map
   data-parallel updates (CPU-mesh testable, no TPU required).
 - `arena.baseline` — the deliberately naive loop implementation the
@@ -17,24 +20,32 @@ Modules:
 """
 
 from arena.engine import ArenaEngine, bucket_size, pack_batch, pack_epoch
+from arena.ingest import MergeableCSR, StagingBuffers, chunk_layout
 from arena.ratings import (
     bt_fit,
+    bt_fit_chunked,
     elo_batch_update,
     elo_batch_update_sorted,
     elo_epoch,
     elo_expected,
     sorted_segment_sum,
+    sorted_segment_sum_chunked,
 )
 
 __all__ = [
     "ArenaEngine",
+    "MergeableCSR",
+    "StagingBuffers",
     "bucket_size",
+    "chunk_layout",
     "pack_batch",
     "pack_epoch",
     "bt_fit",
+    "bt_fit_chunked",
     "elo_batch_update",
     "elo_batch_update_sorted",
     "elo_epoch",
     "elo_expected",
     "sorted_segment_sum",
+    "sorted_segment_sum_chunked",
 ]

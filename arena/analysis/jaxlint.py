@@ -43,8 +43,8 @@ What "jitted" means to the linter (tracked per module):
   callable, with `static_argnums`/`static_argnames` and
   `donate_argnums`/`donate_argnames` read off the call;
 - the repo's own factories: `jit_elo_epoch(...)` (donates argnum 0
-  unless `donate=False`), `jit_bt_fit(...)`, and
-  `sanitize.donation_guard(fn, donate_argnums=...)`.
+  unless `donate=False`), `jit_bt_fit(...)`, `jit_bt_fit_chunked(...)`,
+  and `sanitize.donation_guard(fn, donate_argnums=...)`.
 """
 
 from __future__ import annotations
@@ -127,6 +127,7 @@ _TRACER_DECORATORS = _JIT_NAMES | {"shard_map", "jax.experimental.shard_map.shar
 _FACTORY_TAILS = {
     "jit_elo_epoch": (True, (0,)),
     "jit_bt_fit": (True, ()),
+    "jit_bt_fit_chunked": (True, ()),
 }
 _DONATION_GUARD_TAIL = "donation_guard"
 

@@ -36,10 +36,29 @@ rc 1, which stays reserved for an unwritable stdout). A speedup over
 code computing something different would be fiction, so it is now
 impossible to emit one.
 
-Env knobs (all optional): ARENA_BENCH_MATCHES (100000),
-ARENA_BENCH_PLAYERS (1000), ARENA_BENCH_BATCH (8192),
-ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED (0), ARENA_BENCH_BT_ITERS
-(25), ARENA_BENCH_TOL (0.5 rating points — the equivalence gate),
+A second mode rides the same contract: ``ARENA_BENCH_MODE=ingest``
+measures the INCREMENTAL ingestion layer (`arena/ingest.py`) instead —
+one JSON line with metric ``arena_ingest`` whose ``value`` is how many
+times faster merging a delta into the mergeable CSR grouping is than a
+cold re-pack of the combined set (`engine.pack_epoch`, the
+repack-the-world pattern this PR removes). The same equivalence hard
+gate applies to the incremental path: Elo ratings through
+`ArenaEngine.ingest` must match a cold pack + fused epoch within
+``ARENA_BENCH_TOL`` AND the chunked Bradley–Terry refit must match the
+single-bucket fit within ``ARENA_BENCH_BT_TOL`` — any divergence emits
+the ``arena_bench_equivalence_failure`` line and exits rc 2, never a
+speedup. Steady-state ingest additionally runs under
+`RecompileSentinel` (zero new jit compiles after warmup — a raise
+degrades to the internal-error line, so a broken bucket contract can
+never report a speedup), and the line records the chunked refit's peak
+bucket vs the single-pow2-bucket layout's.
+
+Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest),
+ARENA_BENCH_MATCHES (100000), ARENA_BENCH_PLAYERS (1000),
+ARENA_BENCH_BATCH (8192), ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED
+(0), ARENA_BENCH_BT_ITERS (25), ARENA_BENCH_TOL (0.5 rating points —
+the equivalence gate), ARENA_BENCH_DELTA (10000, ingest mode),
+ARENA_BENCH_BT_TOL (0.01, ingest mode — chunked-vs-single BT gate),
 ARENA_BENCH_DEVICES (unset — forces a host CPU device count for
 the sharded path when the backend is not yet initialized).
 """
@@ -69,7 +88,8 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 import bench  # noqa: E402  (exc_detail — the repo-wide error formatting)
-from arena import baseline, engine, ratings, sharding  # noqa: E402
+from arena import baseline, engine, ingest, ratings, sharding  # noqa: E402
+from arena.analysis import sanitize  # noqa: E402
 
 # Max |rating diff| tolerated between the naive float64 loop and the
 # float32 scatter-free path, in rating points on the 1500 scale
@@ -242,10 +262,177 @@ def run_benchmark():
     }
 
 
+def _batch_slices(total, batch):
+    return [(start, min(start + batch, total)) for start in range(0, total, batch)]
+
+
+def run_ingest_benchmark():
+    """The incremental-ingest comparison: merge a delta into a live
+    mergeable grouping vs cold re-pack of the combined set, with the
+    equivalence gate extended to the incremental Elo and chunked BT
+    paths and a RecompileSentinel over steady-state ingest."""
+    base_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
+    delta_matches = _env_int("ARENA_BENCH_DELTA", 10_000)
+    num_players = _env_int("ARENA_BENCH_PLAYERS", 1_000)
+    batch = _env_int("ARENA_BENCH_BATCH", 8_192)
+    repeats = _env_int("ARENA_BENCH_REPEATS", 5)
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+    bt_iters = _env_int("ARENA_BENCH_BT_ITERS", 25)
+    chunk_entries = _env_int(
+        "ARENA_BENCH_CHUNK_ENTRIES", ingest.DEFAULT_CHUNK_ENTRIES
+    )
+    total = base_matches + delta_matches
+
+    winners, losers = make_matches(total, num_players, seed)
+
+    # --- cold re-pack of the COMBINED set (what absorbing the delta
+    # costs today: the whole-set grouping recomputed from scratch) ----
+    cold_pack_s = _best_of(
+        lambda: jax.block_until_ready(
+            engine.pack_epoch(num_players, winners, losers, batch).perms
+        ),
+        repeats,
+    )
+
+    # --- incremental: merge ONLY the delta into a live base grouping -
+    base_csr = ingest.MergeableCSR(num_players)
+    for start, stop in _batch_slices(base_matches, batch):
+        base_csr.add(winners[start:stop], losers[start:stop])
+    base_csr.compact()
+    delta_slices = [
+        (base_matches + a, base_matches + b)
+        for a, b in _batch_slices(delta_matches, batch)
+    ]
+    incremental_merge_s = float("inf")
+    live = None
+    for _ in range(repeats):
+        live = base_csr.clone()  # clone cost excluded: it models the
+        # already-resident base, not work the merge performs
+        t0 = time.perf_counter()
+        for start, stop in delta_slices:
+            live.add(winners[start:stop], losers[start:stop])
+        live.compact()
+        incremental_merge_s = min(
+            incremental_merge_s, time.perf_counter() - t0
+        )
+    speedup = cold_pack_s / incremental_merge_s
+
+    # --- equivalence gate, Elo: the incremental engine path must land
+    # on the same ratings as a cold pack + fused epoch ----------------
+    eng = engine.ArenaEngine(num_players)
+    chunks = _batch_slices(total, batch)
+    eng.ingest(winners[chunks[0][0] : chunks[0][1]], losers[chunks[0][0] : chunks[0][1]])
+    sentinel = sanitize.RecompileSentinel(update=eng.num_compiles)
+    for start, stop in chunks[1:-1]:
+        eng.ingest(winners[start:stop], losers[start:stop])
+    # Steady state means ZERO new compiles: an unbucketed shape leaking
+    # into the jitted signature raises here (degrading to the
+    # internal-error line — no speedup is ever reported over a broken
+    # bucket contract).
+    sentinel.assert_no_new_compiles()
+    if len(chunks) > 1:
+        start, stop = chunks[-1]
+        eng.ingest(winners[start:stop], losers[start:stop])  # partial
+        # bucket: may legitimately compile ONE new entry, outside the
+        # steady-state window.
+    ratings_incremental = np.asarray(eng.ratings)
+
+    packed = engine.pack_epoch(num_players, winners, losers, batch)
+    epoch_fn = ratings.jit_elo_epoch(num_players, donate=False)
+    r0 = jnp.full((num_players,), ratings.DEFAULT_BASE, jnp.float32)
+    ratings_cold = np.asarray(
+        epoch_fn(
+            r0, packed.winners, packed.losers, packed.valid, packed.perms,
+            packed.bounds,
+        )
+    )
+    max_diff = float(np.abs(ratings_incremental - ratings_cold).max())
+    tol = float(os.environ.get("ARENA_BENCH_TOL", EQUIVALENCE_TOL))
+    if not max_diff < tol:
+        raise EquivalenceError(max_diff, tol)
+
+    # --- equivalence gate + peak bucket, BT: chunked refit vs the
+    # single-pow2-bucket fit ------------------------------------------
+    single_bucket = engine.bucket_size(total)
+    whole = engine.pack_batch(num_players, winners, losers, min_bucket=single_bucket)
+    win_counts = jnp.asarray(
+        np.bincount(winners, minlength=num_players).astype(np.float32)
+    )
+    single_fit = ratings.jit_bt_fit(num_players, num_iters=bt_iters)
+
+    def single_run():
+        return single_fit(
+            whole.winners, whole.losers, whole.valid, whole.perm, whole.bounds,
+            win_counts,
+        )
+
+    single_strengths = np.asarray(jax.block_until_ready(single_run()))  # warmup
+    single_iter_s = _best_of(
+        lambda: jax.block_until_ready(single_run()), repeats
+    ) / bt_iters
+
+    chunked_strengths = np.asarray(
+        eng.refit_incremental(num_iters=bt_iters, chunk_entries=chunk_entries)
+    )
+    chunked_iter_s = _best_of(
+        lambda: jax.block_until_ready(
+            eng.refit_incremental(num_iters=bt_iters, chunk_entries=chunk_entries)
+        ),
+        repeats,
+    ) / bt_iters
+
+    max_strength_diff = float(
+        np.abs(chunked_strengths - single_strengths).max()
+    )
+    bt_tol = float(os.environ.get("ARENA_BENCH_BT_TOL", 0.01))
+    if not max_strength_diff < bt_tol:
+        raise EquivalenceError(max_strength_diff, bt_tol)
+
+    return {
+        "metric": "arena_ingest",
+        "value": round(speedup, 2),
+        "unit": "x_vs_cold_repack",
+        "vs_baseline": None,
+        "params": {
+            "base_matches": base_matches,
+            "delta_matches": delta_matches,
+            "num_players": num_players,
+            "batch_size": batch,
+            "repeats": repeats,
+            "seed": seed,
+            "chunk_entries": chunk_entries,
+        },
+        "ingest": {
+            "cold_pack_s": round(cold_pack_s, 6),
+            "incremental_merge_s": round(incremental_merge_s, 6),
+            "delta_matches_per_s": round(delta_matches / incremental_merge_s),
+            "compactions": live.compactions,
+            "staging_slots": eng._staging.slots_allocated,
+            "steady_state_new_compiles": 0,  # sentinel raised otherwise
+        },
+        "bt": {
+            "iters": bt_iters,
+            "single_iter_s": round(single_iter_s, 6),
+            "chunked_iter_s": round(chunked_iter_s, 6),
+            # The memory-cliff fact: the chunked path's largest padded
+            # buffer (one chunk) vs the single pow2 pad (2*bucket).
+            "single_bucket_entries": 2 * single_bucket,
+            "chunked_peak_entries": chunk_entries,
+            "peak_bucket_ratio": round(2 * single_bucket / chunk_entries, 2),
+        },
+        "equivalence_ok": True,
+        "max_rating_diff": round(max_diff, 6),
+        "max_strength_diff": round(max_strength_diff, 6),
+    }
+
+
 def main() -> int:
     rc = 0
+    mode = os.environ.get("ARENA_BENCH_MODE", "elo")
+    runner = run_ingest_benchmark if mode == "ingest" else run_benchmark
+    unit = "x_vs_cold_repack" if mode == "ingest" else "x_vs_naive_baseline"
     try:
-        line = json.dumps(run_benchmark())
+        line = json.dumps(runner())
     except EquivalenceError as exc:
         # A measured verdict, not a crash: the paths diverged, so the
         # line carries the divergence instead of a speedup and the
@@ -254,7 +441,7 @@ def main() -> int:
             {
                 "metric": "arena_bench_equivalence_failure",
                 "value": -1,
-                "unit": "x_vs_naive_baseline",
+                "unit": unit,
                 "vs_baseline": None,
                 "max_rating_diff": round(exc.max_diff, 6),
                 "tolerance": exc.tol,
@@ -267,7 +454,7 @@ def main() -> int:
             {
                 "metric": "arena_bench_internal_error",
                 "value": -1,
-                "unit": "x_vs_naive_baseline",
+                "unit": unit,
                 "vs_baseline": None,
                 "error": bench.exc_detail(exc),
             }

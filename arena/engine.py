@@ -23,7 +23,12 @@ The host-side half of the hot path. Three jobs:
    vector, feeds batches through a single jitted update with the
    ratings buffer donated (XLA reuses the old buffer for the new
    ratings instead of allocating), and exposes leaderboard reads and
-   batched Bradley–Terry fits over everything ingested so far.
+   batched Bradley–Terry fits over everything ingested so far. Since
+   PR 3 it also fronts the INCREMENTAL path (`arena/ingest.py`):
+   `ingest()` packs through reusable double-buffered staging slots and
+   merges the whole-set grouping incrementally, and
+   `refit_incremental()` runs the chunked Bradley–Terry fit over that
+   grouping — no repack-the-world, peak bucket one chunk.
 """
 
 from functools import partial
@@ -185,23 +190,27 @@ class ArenaEngine:
         self.min_bucket = min_bucket
         self._dtype = dtype
         self.ratings = jnp.full((num_players,), base, dtype)
-        self.matches_ingested = 0
-        # Everything ingested, kept host-side for Bradley–Terry refits.
-        self._winners = []
-        self._losers = []
+        # ONE match store serves every path: update() and ingest()
+        # both feed the mergeable CSR, so Bradley–Terry refits (single
+        # -bucket bt_strengths or chunked refit_incremental) always see
+        # the full history regardless of which ingest path ran.
+        # Imported lazily: arena.ingest imports this module's
+        # primitives at its own top level.
+        from arena import ingest as ingest_mod
+
+        self._ingest_mod = ingest_mod
+        self._store = ingest_mod.MergeableCSR(num_players)
+        self._staging = None  # built on first ingest()
         self._update = jax.jit(
             partial(R.elo_batch_update_sorted, k=k, scale=scale),
             donate_argnums=(0,),
         )
 
-    def update(self, winners, losers):
-        """Ingest one batch of outcomes and apply one batched Elo round."""
-        packed = pack_batch(
-            self.num_players, winners, losers, self.min_bucket, np.float32
-        )
-        self._winners.append(np.asarray(winners, np.int32))
-        self._losers.append(np.asarray(losers, np.int32))
-        self.matches_ingested += packed.num_real
+    @property
+    def matches_ingested(self):
+        return self._store.num_matches
+
+    def _apply(self, packed):
         self.ratings = self._update(
             self.ratings,
             packed.winners,
@@ -211,6 +220,66 @@ class ArenaEngine:
             packed.bounds,
         )
         return self.ratings
+
+    def update(self, winners, losers):
+        """Ingest one batch of outcomes and apply one batched Elo round."""
+        packed = pack_batch(
+            self.num_players, winners, losers, self.min_bucket, np.float32
+        )
+        self._store.add(winners, losers)
+        return self._apply(packed)
+
+    def ingest(self, winners, losers):
+        """`update` on the incremental path: the batch is packed
+        through reusable double-buffered staging slots (zero host
+        allocations and zero new jit compiles in steady state) and
+        merged into the incrementally-maintained whole-set grouping
+        (O(d log d) delta sort + deferred galloping merge) instead of
+        being re-grouped from scratch at the next refit. Identical
+        rating semantics to `update` — same jitted function, same
+        packed layout — pinned by tests."""
+        w = np.asarray(winners, np.int32)
+        l = np.asarray(losers, np.int32)
+        _validate_matches(self.num_players, w, l)
+        if self._staging is None:
+            self._staging = self._ingest_mod.StagingBuffers(
+                self.num_players, self.min_bucket, np.float32
+            )
+        self._store.add(w, l)
+        if w.shape[0] == 0:
+            return self.ratings  # nothing to dispatch
+        return self._apply(self._staging.stage(w, l))
+
+    def refit_incremental(self, num_iters=50, prior=0.1, chunk_entries=None):
+        """Chunked Bradley–Terry refit over the incremental grouping.
+
+        Reuses the mergeable CSR (at most one tail merge, never a
+        re-pack of the world) and chunks the MM segment sums over the
+        epoch layout — the largest allocated bucket is one chunk, not
+        the single pow2 pad of the whole match set (`bt_strengths`'s
+        layout). Same model, same fixed point as `bt_strengths`;
+        equivalence is property-tested.
+        """
+        if self._store.num_matches == 0:
+            raise ValueError("no matches ingested")
+        if chunk_entries is None:
+            chunk_entries = self._ingest_mod.DEFAULT_CHUNK_ENTRIES
+        perm, bounds = self._store.grouping()
+        perms, chunk_bounds = self._ingest_mod.chunk_layout(
+            perm, bounds, chunk_entries
+        )
+        w = self._store.winners()
+        win_counts = jnp.asarray(
+            np.bincount(w, minlength=self.num_players).astype(np.float32)
+        )
+        fit = R.jit_bt_fit_chunked(self.num_players, num_iters=num_iters, prior=prior)
+        return fit(
+            jnp.asarray(w),
+            jnp.asarray(self._store.losers()),
+            jnp.asarray(perms),
+            jnp.asarray(chunk_bounds),
+            win_counts,
+        )
 
     def num_compiles(self):
         """Jit-cache size of the update fn — the recompile budget the
@@ -232,10 +301,10 @@ class ArenaEngine:
         the standard periodic companion to online ratings. Runs as one
         fused scan over `num_iters` MM steps (see `ratings.bt_fit`).
         """
-        if not self._winners:
+        if self._store.num_matches == 0:
             raise ValueError("no matches ingested")
-        w = np.concatenate(self._winners)
-        l = np.concatenate(self._losers)
+        w = self._store.winners()
+        l = self._store.losers()
         b = bucket_size(len(w), self.min_bucket) if batch_size is None else batch_size
         # One whole-set "batch": BT iterates over the full match set.
         packed = pack_batch(self.num_players, w, l, b)

@@ -1,0 +1,343 @@
+"""Incremental ingestion: mergeable CSR packing + double-buffered staging.
+
+PR 1 made the rating math ~55-70x faster but left the host standing
+still: every cold pass re-sorts and re-groups the ENTIRE match set
+(`engine.pack_epoch`, one NumPy counting sort per batch, ~50ms per
+100k matches), and the whole-set Bradley–Terry refit re-packs from
+scratch into a single pow2 bucket. This module removes the
+repack-the-world pattern so the engine can absorb a continuous arena
+match stream:
+
+1. **`MergeableCSR`** — the whole-set per-player grouping kept as a
+   MERGEABLE structure instead of a recompute-from-scratch artifact.
+   The packed match set lives as sorted per-player runs (`_keys`
+   ascending player id, `_pos` the matching entry positions) plus a
+   small unsorted delta tail of recently added batches. Merging a new
+   batch costs an O(d log d) sort of just the delta; when the tail
+   exceeds `compact_threshold` entries, ONE linear galloping merge
+   (`_gallop_merge`: vectorized binary/exponential search of the
+   sorted tail into the runs, then two fancy-index copies) folds it
+   into the main runs — the full O(N log N) re-sort never happens
+   again after the first build. Entry positions use the INTERLEAVED
+   convention: match i's winner entry is position 2i, its loser entry
+   2i+1, so previously-merged positions never shift when matches are
+   appended (the concat([winners, losers]) convention of
+   `engine.pack_batch` would renumber every loser entry on each
+   append).
+
+2. **`StagingBuffers`** — double-buffered, bucket-sized host staging
+   for the per-batch Elo path. Two reusable slots per pow2 bucket:
+   a merge fills one slot's preallocated arrays in place while the
+   device may still be consuming the previous dispatch's slot
+   (dispatch is asynchronous), so steady-state ingest performs zero
+   host-side buffer allocations and — because slot shapes ARE the
+   pow2 buckets — zero new jit compiles (enforced with
+   `RecompileSentinel` in tests and in `bench_arena.py`'s ingest
+   mode). On this CPU backend "pinned" is a no-op and `jnp.asarray`
+   still copies host→device; the reuse is host-side, and the
+   two-slot rotation is the shape an accelerator backend needs for
+   true transfer/compute overlap.
+
+3. **`chunk_layout`** — splits the merged whole-set grouping into the
+   epoch layout (multiple fixed-size chunks over the SORTED entry
+   order) that `ratings.bt_fit_chunked` scans, instead of padding
+   everything into one pow2 bucket. Padded slots in the last chunk
+   point at a sentinel position (one appended zero in the values
+   array), so no validity mask is needed: the match arrays themselves
+   are exact-length. The largest allocated bucket becomes one chunk
+   (`chunk_entries`), not `2*pow2(num_matches)` — the 2x memory cliff
+   the ISSUE names.
+
+Everything here is host-side NumPy (jnp only at the final
+device-transfer boundary), matching the ingest discipline jaxlint's
+`jnp-on-host-path` rule enforces.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from arena.engine import (
+    MIN_BUCKET,
+    PackedBatch,
+    _validate_matches,
+    bucket_size,
+)
+
+# Tail entries (2 per match) tolerated before a galloping merge folds
+# the delta into the main runs. Compaction is O(main + tail); a larger
+# threshold amortizes it over more batches at the price of a bigger
+# merge at grouping() time. 16384 entries = 8192 matches, one default
+# bench batch.
+DEFAULT_COMPACT_THRESHOLD = 16_384
+
+# Sorted-order entries per chunk in the epoch layout handed to the
+# chunked Bradley-Terry fit (2 entries per match -> 8192 matches).
+DEFAULT_CHUNK_ENTRIES = 16_384
+
+
+def _gallop_merge(keys_a, pos_a, keys_b, pos_b):
+    """Linear merge of two sorted (keys, pos) runs, no re-sort.
+
+    `keys_b` is binary/exponential-searched into `keys_a` in one
+    vectorized `searchsorted` (the galloping step), then both runs are
+    placed with two fancy-index copies — O(len_a + len_b) data
+    movement, never an O(n log n) sort over the combined set.
+    side="right" appends new entries AFTER existing equal keys, so a
+    player's run stays ordered by insertion time.
+    """
+    if keys_a.size == 0:
+        return keys_b.copy(), pos_b.copy()
+    if keys_b.size == 0:
+        return keys_a, pos_a
+    out_k = np.empty(keys_a.size + keys_b.size, keys_a.dtype)
+    out_p = np.empty(pos_a.size + pos_b.size, pos_a.dtype)
+    b_dest = np.searchsorted(keys_a, keys_b, side="right") + np.arange(
+        keys_b.size, dtype=np.int64
+    )
+    out_k[b_dest] = keys_b
+    out_p[b_dest] = pos_b
+    a_mask = np.ones(out_k.size, bool)
+    a_mask[b_dest] = False
+    out_k[a_mask] = keys_a
+    out_p[a_mask] = pos_a
+    return out_k, out_p
+
+
+class MergeableCSR:
+    """Whole-set per-player grouping maintained incrementally.
+
+    Holds the full match history (`winners()`/`losers()`, growable
+    arrays with amortized doubling) AND its grouping: for every match
+    two entries (winner at interleaved position 2i, loser at 2i+1),
+    grouped by player id. `add` sorts only the new batch and appends
+    it to the delta tail; `compact` gallop-merges the tail into the
+    main sorted runs; `grouping` returns the merged `(perm, bounds)` —
+    drop-in for `sorted_segment_sum` over interleaved values.
+    """
+
+    def __init__(self, num_players, compact_threshold=DEFAULT_COMPACT_THRESHOLD):
+        if num_players < 2:
+            raise ValueError("an arena needs at least two players")
+        self.num_players = num_players
+        self.compact_threshold = compact_threshold
+        self.num_matches = 0
+        self.compactions = 0
+        # Main sorted runs: keys ascending player id, pos the
+        # interleaved entry positions in that order.
+        self._keys = np.empty(0, np.int32)
+        self._pos = np.empty(0, np.int32)
+        # Delta tail: per-batch sorted runs not yet merged into main.
+        self._tail_keys = []
+        self._tail_pos = []
+        self._tail_entries = 0
+        # Match history, capacity-doubled so add() is amortized O(d).
+        self._w = np.empty(1024, np.int32)
+        self._l = np.empty(1024, np.int32)
+
+    def _reserve(self, n):
+        need = self.num_matches + n
+        if need <= self._w.size:
+            return
+        cap = self._w.size
+        while cap < need:
+            cap *= 2
+        for name in ("_w", "_l"):
+            grown = np.empty(cap, np.int32)
+            grown[: self.num_matches] = getattr(self, name)[: self.num_matches]
+            setattr(self, name, grown)
+
+    def winners(self):
+        return self._w[: self.num_matches]
+
+    def losers(self):
+        return self._l[: self.num_matches]
+
+    @property
+    def tail_entries(self):
+        """Entries (2 per match) waiting in the unmerged delta tail."""
+        return self._tail_entries
+
+    def add(self, winners, losers):
+        """Merge one batch: O(d log d) sort of the delta, deferred
+        linear galloping merge. Returns the number of matches added."""
+        w = np.asarray(winners, np.int32)
+        l = np.asarray(losers, np.int32)
+        _validate_matches(self.num_players, w, l)
+        d = w.shape[0]
+        if d == 0:
+            return 0
+        self._reserve(d)
+        base = self.num_matches
+        self._w[base : base + d] = w
+        self._l[base : base + d] = l
+        wpos = (2 * base + 2 * np.arange(d)).astype(np.int32)
+        keys = np.concatenate([w, l])
+        pos = np.concatenate([wpos, wpos + 1])
+        order = np.argsort(keys, kind="stable").astype(np.int64)
+        self._tail_keys.append(keys[order].astype(np.int32))
+        self._tail_pos.append(pos[order])
+        self._tail_entries += 2 * d
+        self.num_matches += d
+        if self._tail_entries > self.compact_threshold:
+            self.compact()
+        return d
+
+    def compact(self):
+        """Fold the delta tail into the main runs: one stable sort of
+        the (small) tail, one linear galloping merge. No-op when the
+        tail is empty."""
+        if not self._tail_keys:
+            return
+        tail_k = np.concatenate(self._tail_keys)
+        tail_p = np.concatenate(self._tail_pos)
+        order = np.argsort(tail_k, kind="stable").astype(np.int64)
+        self._keys, self._pos = _gallop_merge(
+            self._keys, self._pos, tail_k[order], tail_p[order]
+        )
+        self._tail_keys = []
+        self._tail_pos = []
+        self._tail_entries = 0
+        self.compactions += 1
+
+    def grouping(self):
+        """Merged `(perm, bounds)` over all `2*num_matches` entries.
+
+        `perm` holds interleaved entry positions in player-sorted
+        order; `bounds[p]` is player p's start offset (length
+        num_players+1). Compacts first, so the returned view IS the
+        main runs — callers pay at most one tail merge, never a full
+        re-sort.
+        """
+        self.compact()
+        bounds = np.searchsorted(
+            self._keys, np.arange(self.num_players + 1), side="left"
+        ).astype(np.int32)
+        return self._pos, bounds
+
+    def clone(self):
+        """Independent copy (bench baseline-vs-delta runs; also the
+        seed of the snapshot/restore the serving layer will need)."""
+        other = MergeableCSR(self.num_players, self.compact_threshold)
+        other.num_matches = self.num_matches
+        other.compactions = self.compactions
+        other._keys = self._keys.copy()
+        other._pos = self._pos.copy()
+        other._tail_keys = [run.copy() for run in self._tail_keys]
+        other._tail_pos = [run.copy() for run in self._tail_pos]
+        other._tail_entries = self._tail_entries
+        other._w = self._w.copy()
+        other._l = self._l.copy()
+        return other
+
+
+class _Slot:
+    """One staging slot: preallocated bucket-shaped host arrays."""
+
+    def __init__(self, bucket, num_players, dtype):
+        self.w = np.zeros(bucket, np.int32)
+        self.l = np.zeros(bucket, np.int32)
+        self.valid = np.zeros(bucket, dtype)
+        self.combined = np.empty(2 * bucket, np.int32)
+        self.sorted_keys = np.empty(2 * bucket, np.int32)
+        self.perm = np.empty(2 * bucket, np.int32)
+        self.bounds = np.empty(num_players + 1, np.int32)
+
+
+class StagingBuffers:
+    """Reusable, double-buffered host→device staging per pow2 bucket.
+
+    `stage(winners, losers)` fills the NEXT slot of the batch's bucket
+    in place (pad, group, bound — the same layout `engine.pack_batch`
+    computes into fresh allocations) and returns a `PackedBatch` of
+    device arrays. Slots rotate, so the host never overwrites the
+    arrays a still-in-flight dispatch was staged from, and steady
+    state allocates nothing: `slots_allocated` stops growing after
+    warmup, and because slot shapes are exactly the pow2 buckets the
+    jit cache stops growing too (the `RecompileSentinel` contract).
+    """
+
+    def __init__(self, num_players, min_bucket=MIN_BUCKET, dtype=np.float32, depth=2):
+        if depth < 2:
+            raise ValueError("double buffering needs at least two slots per bucket")
+        self.num_players = num_players
+        self.min_bucket = min_bucket
+        self.depth = depth
+        self._dtype = dtype
+        self._rings = {}  # bucket -> list of slots
+        self._next = {}  # bucket -> rotation index
+        self.slots_allocated = 0
+        self.stages = 0
+
+    def _acquire(self, bucket):
+        ring = self._rings.get(bucket)
+        if ring is None:
+            ring = []
+            self._rings[bucket] = ring
+            self._next[bucket] = 0
+        if len(ring) < self.depth:
+            ring.append(_Slot(bucket, self.num_players, self._dtype))
+            self.slots_allocated += 1
+        slot = ring[self._next[bucket] % len(ring)]
+        self._next[bucket] = (self._next[bucket] + 1) % self.depth
+        return slot
+
+    def stage(self, winners, losers):
+        """Pack one validated batch through a reusable slot."""
+        w = np.asarray(winners, np.int32)
+        l = np.asarray(losers, np.int32)
+        _validate_matches(self.num_players, w, l)
+        n = w.shape[0]
+        b = bucket_size(n, self.min_bucket)
+        slot = self._acquire(b)
+        slot.w[:n] = w
+        slot.w[n:] = 0
+        slot.l[:n] = l
+        slot.l[n:] = 0
+        slot.valid[:n] = 1
+        slot.valid[n:] = 0
+        slot.combined[:b] = slot.w
+        slot.combined[b:] = slot.l
+        slot.perm[:] = np.argsort(slot.combined, kind="stable")
+        slot.sorted_keys[:] = slot.combined[slot.perm]
+        slot.bounds[:] = np.searchsorted(
+            slot.sorted_keys, np.arange(self.num_players + 1), side="left"
+        )
+        self.stages += 1
+        return PackedBatch(
+            jnp.asarray(slot.w),
+            jnp.asarray(slot.l),
+            jnp.asarray(slot.valid),
+            jnp.asarray(slot.perm),
+            jnp.asarray(slot.bounds),
+            n,
+        )
+
+
+def chunk_layout(perm, bounds, chunk_entries=DEFAULT_CHUNK_ENTRIES):
+    """Split a merged whole-set grouping into the chunked epoch layout.
+
+    Returns `(perms, chunk_bounds)` for `ratings.bt_fit_chunked`:
+    `perms` is (num_chunks, chunk_entries) int32 over the SORTED entry
+    order, padded with the sentinel position `total` (the index of the
+    one appended zero in the values array — padding lives in sorted
+    space, so the match arrays stay exact-length and need no validity
+    mask); `chunk_bounds` is (num_chunks, num_players+1), the global
+    bounds clipped into each chunk. The largest allocated bucket is
+    one chunk — strictly smaller than the single-pow2-bucket packing
+    whenever `chunk_entries < 2*bucket_size(num_matches)`.
+    """
+    if chunk_entries < 1:
+        raise ValueError("chunk_entries must be >= 1")
+    total = int(perm.shape[0])
+    if total == 0:
+        raise ValueError("cannot lay out an empty grouping")
+    num_chunks = -(-total // chunk_entries)
+    padded = np.full(num_chunks * chunk_entries, total, np.int32)
+    padded[:total] = perm
+    perms = padded.reshape(num_chunks, chunk_entries)
+    starts = (np.arange(num_chunks, dtype=np.int64) * chunk_entries)[:, None]
+    chunk_bounds = np.clip(
+        bounds[None, :].astype(np.int64) - starts, 0, chunk_entries
+    ).astype(np.int32)
+    return perms, chunk_bounds

@@ -205,6 +205,84 @@ def bt_fit(
     return out
 
 
+def sorted_segment_sum_chunked(values, perms, bounds):
+    """Scatter-free segment sum over a CHUNKED grouping.
+
+    The whole-set grouping split into fixed-size chunks over the
+    sorted entry order (`arena.ingest.chunk_layout`): `perms` is
+    (num_chunks, C) of positions into `values`, `bounds` is
+    (num_chunks, P+1) per-chunk clipped segment offsets. `values` must
+    carry ONE trailing zero sentinel (length E+1 for E real entries) —
+    padded perm slots point at it, so no validity mask exists anywhere
+    on this path. A `lax.scan` accumulates per-chunk partial segment
+    sums; the largest live buffer is one chunk (C), never the
+    2*pow2(N) single-bucket pad.
+    """
+
+    def step(acc, chunk):
+        p, b = chunk
+        cs = jnp.concatenate(
+            [jnp.zeros((1,), values.dtype), jnp.cumsum(values[p])]
+        )
+        return acc + (cs[b[1:]] - cs[b[:-1]]), None
+
+    init = jnp.zeros((bounds.shape[1] - 1,), values.dtype)
+    out, _ = jax.lax.scan(step, init, (perms, bounds))
+    return out
+
+
+def bt_mm_step_chunked(strengths, winners, losers, perms, bounds, win_counts, prior):
+    """One Bradley–Terry MM update via the chunked segment sum.
+
+    Same update rule as `bt_mm_step`; the denominator accumulates
+    chunk-by-chunk instead of through one bucket-wide cumsum. The
+    winners/losers arrays are EXACT length (no pad matches): match i's
+    two entries live at interleaved positions 2i (winner) and 2i+1
+    (loser), both carrying 1/(p_w + p_l) — `jnp.repeat(inv, 2)` lays
+    the values out in exactly that order.
+    """
+    inv = 1.0 / (strengths[winners] + strengths[losers])
+    values = jnp.concatenate([jnp.repeat(inv, 2), jnp.zeros((1,), inv.dtype)])
+    denom = sorted_segment_sum_chunked(values, perms, bounds)
+    denom = denom + 2.0 * prior / (strengths + 1.0)
+    new = (win_counts + prior) / denom
+    return new * jnp.exp(-jnp.mean(jnp.log(new)))
+
+
+def bt_fit_chunked(
+    num_players,
+    winners,
+    losers,
+    perms,
+    bounds,
+    win_counts,
+    num_iters=50,
+    prior=0.1,
+    dtype=jnp.float32,
+):
+    """Bradley–Terry MLE over the chunked epoch layout: `num_iters` MM
+    steps fused in one scan, peak bucket = one chunk instead of one
+    pow2 pad of the whole set. Wrap in jit at the call site
+    (`jit_bt_fit_chunked`)."""
+    init = jnp.ones((num_players,), dtype)
+
+    def step(p, _):
+        return (
+            bt_mm_step_chunked(p, winners, losers, perms, bounds, win_counts, prior),
+            None,
+        )
+
+    out, _ = jax.lax.scan(step, init, None, length=num_iters)
+    return out
+
+
+def jit_bt_fit_chunked(num_players, num_iters=50, prior=0.1):
+    """`bt_fit_chunked` compiled for a fixed player count / budget."""
+    return jax.jit(
+        partial(bt_fit_chunked, num_players, num_iters=num_iters, prior=prior)
+    )
+
+
 def bt_log_likelihood(strengths, winners, losers, valid=None):
     """Total log-likelihood of the observed outcomes (for tests: each
     MM step must not decrease it)."""

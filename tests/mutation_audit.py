@@ -236,6 +236,37 @@ MUTATIONS = (
         "linter still reports success — the corpus test must catch it",
     ),
     (
+        "ingest-drops-the-delta-tail",
+        "arena/ingest.py",
+        "        self._keys, self._pos = _gallop_merge(\n"
+        "            self._keys, self._pos, tail_k[order], tail_p[order]\n"
+        "        )",
+        "        self._keys, self._pos = self._keys, self._pos",
+        "compaction must MERGE the delta tail into the main runs, never "
+        "silently discard it — killed by "
+        "test_galloping_merge_preserves_every_entry (and every ingest "
+        "equivalence property)",
+    ),
+    (
+        "ingest-compaction-threshold-inverted",
+        "arena/ingest.py",
+        "        if self._tail_entries > self.compact_threshold:",
+        "        if self._tail_entries < self.compact_threshold:",
+        "the compaction threshold gates WHEN the galloping merge runs: "
+        "inverted, every small add pays a merge (or the tail never folds) — "
+        "killed by test_compaction_respects_threshold",
+    ),
+    (
+        "chunked-bt-padded-back-to-one-bucket",
+        "arena/ingest.py",
+        "    num_chunks = -(-total // chunk_entries)",
+        "    chunk_entries = bucket_size(total)\n    num_chunks = 1",
+        "the chunked BT layout exists to cap the peak bucket at one chunk; "
+        "padding everything back into one pow2 bucket reintroduces the 2x "
+        "memory cliff — killed by "
+        "test_chunk_layout_peak_bucket_strictly_smaller_than_pow2",
+    ),
+    (
         "lint-donation-poisoning-dropped",
         "arena/analysis/jaxlint.py",
         "                            if target_name:\n"

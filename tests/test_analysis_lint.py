@@ -73,6 +73,19 @@ def test_host_sync_rule_names_each_call_form():
         assert call_form in messages, f"host-sync rule no longer flags {call_form}"
 
 
+def test_default_targets_cover_the_ingest_module():
+    """The six rules gate the NEW hot path too: arena/ingest.py must be
+    inside the default-target walk (so `python -m arena.analysis` and
+    the clean-tree test both lint it) and must itself lint clean."""
+    walked = {
+        str(f) for f in jaxlint.iter_python_files(jaxlint.default_targets())
+    }
+    ingest_path = str(REPO / "arena" / "ingest.py")
+    assert ingest_path in walked
+    findings = jaxlint.lint_paths([ingest_path])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
 def test_default_walk_skips_the_corpus():
     """`jaxlint arena/` must not see badcorpus/ (clean tree stays
     clean) while linting the corpus dir explicitly must."""
@@ -168,7 +181,10 @@ def test_cli_subprocess_contract():
     arena.analysis` imports the arena package, whose __init__ pulls
     jax from site-packages)."""
     clean = subprocess.run(
-        [sys.executable, "-m", "arena.analysis", "arena/", "bench.py"],
+        [
+            sys.executable, "-m", "arena.analysis",
+            "arena/", "arena/ingest.py", "bench.py",
+        ],
         capture_output=True, text=True, cwd=REPO, timeout=120,
     )
     assert clean.returncode == 0, clean.stdout + clean.stderr

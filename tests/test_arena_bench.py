@@ -92,6 +92,67 @@ def test_bench_full_size_hits_50x_with_sharded_path():
     assert result["sharded"]["matches_per_s"] > 0
 
 
+INGEST_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "ingest",
+    "ARENA_BENCH_MATCHES": "20000",
+    "ARENA_BENCH_DELTA": "2000",
+    "ARENA_BENCH_PLAYERS": "64",
+    "ARENA_BENCH_BATCH": "2048",
+    "ARENA_BENCH_REPEATS": "2",
+    "ARENA_BENCH_BT_ITERS": "5",
+    "ARENA_BENCH_CHUNK_ENTRIES": "4096",
+}
+
+
+def test_ingest_bench_smoke_contract():
+    """ARENA_BENCH_MODE=ingest through the real entrypoint: one JSON
+    line, rc 0, the arena_ingest metric with the incremental merge
+    beating the cold re-pack, zero steady-state compiles, and the
+    chunked BT peak bucket strictly under the single pow2 pad."""
+    result = run_bench(INGEST_SMOKE_ENV)
+    assert result["metric"] == "arena_ingest"
+    assert result["unit"] == "x_vs_cold_repack"
+    assert result["equivalence_ok"] is True
+    # Even at smoke size the delta merge must beat repacking the world.
+    assert result["value"] > 1.0
+    assert result["ingest"]["steady_state_new_compiles"] == 0
+    assert result["ingest"]["incremental_merge_s"] < result["ingest"]["cold_pack_s"]
+    assert result["bt"]["chunked_peak_entries"] < result["bt"]["single_bucket_entries"]
+    assert result["max_rating_diff"] < 0.5
+    assert result["params"]["delta_matches"] == 2000
+
+
+def test_ingest_bench_equivalence_gate_extends_to_incremental_path():
+    """The hard gate on the INCREMENTAL path: forcing the chunked-vs-
+    single BT tolerance to 0 must emit the distinct equivalence-failure
+    line (ingest-mode unit, no speedup fields) and exit rc 2."""
+    result = run_bench(
+        {**INGEST_SMOKE_ENV, "ARENA_BENCH_BT_TOL": "0"}, expect_rc=2
+    )
+    assert result["metric"] == "arena_bench_equivalence_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "x_vs_cold_repack"
+    assert result["tolerance"] == 0.0
+    assert "exceeds tolerance" in result["error"]
+    assert "ingest" not in result and "bt" not in result
+
+
+@pytest.mark.slow
+def test_ingest_bench_full_size_hits_5x():
+    """The acceptance number: a 10k delta merged into a 100k base at
+    least 5x faster than the cold re-pack of the combined set, through
+    the real entrypoint at the default sizes."""
+    result = run_bench({"ARENA_BENCH_MODE": "ingest"}, timeout=600)
+    if result["value"] < 5.0:
+        result = run_bench({"ARENA_BENCH_MODE": "ingest"}, timeout=600)
+    assert result["metric"] == "arena_ingest"
+    assert result["params"]["base_matches"] == 100_000
+    assert result["params"]["delta_matches"] == 10_000
+    assert result["value"] >= 5.0, f"incremental merge regressed: {result['value']}x"
+    assert result["ingest"]["steady_state_new_compiles"] == 0
+    assert result["bt"]["chunked_peak_entries"] < result["bt"]["single_bucket_entries"]
+
+
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     """The hard gate: with the tolerance forced to 0 the (real, tiny)
     float32-vs-float64 divergence trips it — one JSON line carrying the

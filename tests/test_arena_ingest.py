@@ -1,0 +1,328 @@
+"""Incremental-ingest contracts (arena/ingest.py + the engine wiring).
+
+The load-bearing property is EQUIVALENCE: any random split of a match
+set into ingest batches must yield the same groupings, the same Elo
+ratings, and the same Bradley–Terry strengths as one cold
+pack-from-scratch pass — otherwise the incremental speedup would be a
+speedup over a different computation. Alongside it, the structural
+contracts each mutation-audit mutant polices by name:
+
+- `test_compaction_respects_threshold` — the delta tail stays pending
+  below `compact_threshold` (adds stay O(d log d)) and folds exactly
+  when the threshold is crossed (mutant: broken threshold comparison);
+- `test_galloping_merge_preserves_every_entry` — compaction merges the
+  tail, never drops it (mutant: skipped galloping merge);
+- `test_chunk_layout_peak_bucket_strictly_smaller_than_pow2` — the
+  chunked BT layout's largest bucket stays one chunk, never the
+  single pow2 pad (mutant: chunked BT padded back to one bucket).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from arena import engine, ingest
+from arena import ratings as R
+from arena.analysis import sanitize
+from arena.engine import ArenaEngine
+
+P = 40
+
+
+def make_matches(n, num_players=P, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, num_players, n)
+    b = (a + 1 + rng.integers(0, num_players - 1, n)) % num_players
+    return a.astype(np.int32), b.astype(np.int32)
+
+
+def random_split(w, l, seed, max_batches=8):
+    """Random contiguous split of a match set into ingest batches,
+    always including at least one empty batch."""
+    rng = np.random.default_rng(seed)
+    cuts = np.sort(rng.integers(0, len(w) + 1, rng.integers(1, max_batches)))
+    bounds = [0, *cuts.tolist(), len(w)]
+    batches = [
+        (w[a:b], l[a:b]) for a, b in zip(bounds, bounds[1:])
+    ]
+    batches.insert(int(rng.integers(0, len(batches) + 1)), (w[:0], l[:0]))
+    return batches
+
+
+def interleaved_keys(w, l):
+    keys = np.empty(2 * len(w), np.int32)
+    keys[0::2] = w
+    keys[1::2] = l
+    return keys
+
+
+def segment_sums_via(csr, values2n):
+    perm, bounds = csr.grouping()
+    return np.asarray(
+        R.sorted_segment_sum(
+            jnp.asarray(values2n), jnp.asarray(perm), jnp.asarray(bounds)
+        )
+    )
+
+
+# --- the equivalence property (the satellite's named test) -----------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_any_random_split_matches_cold_pack(seed):
+    """Property: ingest batches in ANY random split (empty batch
+    included) -> grouping segment sums, engine Elo ratings, and BT
+    refit strengths all match the single cold pass within tolerance
+    (ARENA_BENCH_TOL-style budget, far tighter here)."""
+    w, l = make_matches(900, seed=seed)
+    batches = random_split(w, l, seed=100 + seed)
+    # Grouping: incremental CSR == exact segment sum over the same keys.
+    csr = ingest.MergeableCSR(P, compact_threshold=256)
+    for bw, bl in batches:
+        csr.add(bw, bl)
+    assert csr.num_matches == len(w)
+    vals = np.repeat(
+        np.random.default_rng(seed).normal(size=len(w)).astype(np.float32), 2
+    )
+    got = segment_sums_via(csr, vals)
+    want = np.asarray(
+        jax.ops.segment_sum(
+            jnp.asarray(vals), jnp.asarray(interleaved_keys(w, l)), num_segments=P
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+    # Elo: ingest path == update path, batch for batch, bit-exact
+    # (same jitted function, same packed layout).
+    eng_inc, eng_cold = ArenaEngine(P), ArenaEngine(P)
+    for bw, bl in batches:
+        r_inc = eng_inc.ingest(bw, bl)
+        r_cold = eng_cold.update(bw, bl)
+    np.testing.assert_array_equal(np.asarray(r_inc), np.asarray(r_cold))
+
+    # BT: chunked refit over the incremental grouping == single-bucket
+    # cold fit over the same history.
+    chunked = np.asarray(eng_inc.refit_incremental(num_iters=30, chunk_entries=512))
+    single = np.asarray(eng_cold.bt_strengths(num_iters=30))
+    np.testing.assert_allclose(chunked, single, atol=1e-3)
+
+
+def test_compaction_boundary_split_is_equivalent():
+    """The compaction-boundary case: batch sizes chosen so one add
+    lands exactly ON the threshold (no compaction: strict >) and the
+    next one crosses it mid-stream — grouping must stay exact across
+    the boundary."""
+    w, l = make_matches(600, seed=7)
+    csr = ingest.MergeableCSR(P, compact_threshold=400)
+    csr.add(w[:200], l[:200])  # tail = 400 entries == threshold
+    assert csr.compactions == 0 and csr.tail_entries == 400
+    csr.add(w[200:201], l[200:201])  # crosses: 402 > 400 -> compacts
+    assert csr.compactions == 1 and csr.tail_entries == 0
+    csr.add(w[201:], l[201:])
+    vals = np.repeat(np.arange(len(w), dtype=np.float32), 2)
+    got = segment_sums_via(csr, vals)
+    want = np.asarray(
+        jax.ops.segment_sum(
+            jnp.asarray(vals), jnp.asarray(interleaved_keys(w, l)), num_segments=P
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_empty_batch_is_a_no_op_everywhere():
+    eng = ArenaEngine(P)
+    before = np.asarray(eng.ratings).copy()
+    eng.ingest([], [])
+    np.testing.assert_array_equal(np.asarray(eng.ratings), before)
+    assert eng.matches_ingested == 0
+    csr = ingest.MergeableCSR(P)
+    assert csr.add([], []) == 0
+    assert csr.num_matches == 0 and csr.tail_entries == 0
+    with pytest.raises(ValueError, match="no matches ingested"):
+        eng.refit_incremental()
+
+
+# --- structural contracts (each kills a named mutant) ----------------------
+
+
+def test_compaction_respects_threshold():
+    """Below the threshold the tail stays pending (adds must not pay a
+    merge each); one entry past it, the tail folds into the main runs.
+    Kills the broken-threshold-comparison mutant in both directions:
+    inverted, the first assertion fails (eager compaction); disabled,
+    the second does (tail never folds)."""
+    csr = ingest.MergeableCSR(P, compact_threshold=100)
+    w, l = make_matches(45, seed=3)
+    csr.add(w, l)  # 90 entries: under
+    assert csr.tail_entries == 90
+    assert csr.compactions == 0
+    csr.add(w[:10], l[:10])  # 110 > 100: compacts
+    assert csr.tail_entries == 0
+    assert csr.compactions == 1
+    perm, bounds = csr.grouping()
+    assert perm.size == 2 * 55 and int(bounds[-1]) == 2 * 55
+
+
+def test_galloping_merge_preserves_every_entry():
+    """Compaction must MERGE the delta tail, never drop it: every
+    interleaved entry position survives exactly once and the merged
+    keys are sorted. Kills the skipped-galloping-merge mutant (which
+    silently discards the tail)."""
+    csr = ingest.MergeableCSR(P, compact_threshold=64)
+    total = 0
+    for seed, n in enumerate((40, 11, 90, 5, 64)):
+        w, l = make_matches(n, seed=seed)
+        csr.add(w, l)
+        total += n
+    csr.compact()
+    perm, bounds = csr.grouping()
+    assert np.array_equal(np.sort(perm), np.arange(2 * total))
+    assert int(bounds[-1]) == 2 * total
+    assert np.array_equal(csr._keys, np.sort(csr._keys))
+
+
+def test_chunk_layout_peak_bucket_strictly_smaller_than_pow2():
+    """The memory-cliff fact, pinned: the chunked layout's largest
+    padded buffer is ONE chunk, strictly smaller than the single
+    pow2 bucket whenever the set outgrows a chunk. Kills the
+    pad-chunked-BT-back-to-one-bucket mutant (whose peak becomes the
+    pow2 pad again). The layouts must also agree numerically."""
+    n = 3000
+    w, l = make_matches(n, seed=9)
+    csr = ingest.MergeableCSR(P)
+    csr.add(w, l)
+    perm, bounds = csr.grouping()
+    chunk_entries = 1024
+    perms, chunk_bounds = ingest.chunk_layout(perm, bounds, chunk_entries)
+    single_entries = 2 * engine.bucket_size(n)
+    assert perms.shape[1] < single_entries, (
+        f"chunked peak bucket {perms.shape[1]} must be strictly smaller "
+        f"than the single-pow2 pad {single_entries}"
+    )
+    assert perms.shape == (-(-2 * n // chunk_entries), chunk_entries)
+    # Sentinel pads point one past the last real entry.
+    assert perms.max() == 2 * n
+    wc = jnp.asarray(np.bincount(w, minlength=P).astype(np.float32))
+    chunked = np.asarray(
+        R.jit_bt_fit_chunked(P, num_iters=20)(
+            jnp.asarray(w), jnp.asarray(l), jnp.asarray(perms),
+            jnp.asarray(chunk_bounds), wc,
+        )
+    )
+    whole = engine.pack_batch(P, w, l, min_bucket=engine.bucket_size(n))
+    single = np.asarray(
+        R.jit_bt_fit(P, num_iters=20)(
+            whole.winners, whole.losers, whole.valid, whole.perm,
+            whole.bounds, wc,
+        )
+    )
+    np.testing.assert_allclose(chunked, single, atol=1e-3)
+
+
+def test_chunk_layout_rejects_degenerate_inputs():
+    with pytest.raises(ValueError, match="chunk_entries"):
+        ingest.chunk_layout(np.arange(4, dtype=np.int32), np.zeros(3, np.int32), 0)
+    with pytest.raises(ValueError, match="empty"):
+        ingest.chunk_layout(np.empty(0, np.int32), np.zeros(3, np.int32), 8)
+
+
+# --- staging: reuse, double buffering, zero recompiles ---------------------
+
+
+def test_staging_double_buffers_and_stops_allocating():
+    """Two slots per bucket, rotated: consecutive stages of the same
+    bucket use DIFFERENT host arrays (the in-flight dispatch's source
+    is never overwritten), the third reuses the first, and after both
+    slots exist steady-state traffic allocates nothing."""
+    staging = ingest.StagingBuffers(P, min_bucket=256)
+    w, l = make_matches(100, seed=1)
+    staging.stage(w, l)
+    assert staging.slots_allocated == 1
+    a = staging._rings[256][0]
+    staging.stage(w[:50], l[:50])
+    assert staging.slots_allocated == 2
+    b = staging._rings[256][1]
+    assert a is not b
+    assert staging._next[256] == 0, "third stage must rotate back to slot 0"
+    for n in (1, 7, 100, 255):
+        staging.stage(w[:n], l[:n])
+    assert staging.slots_allocated == 2, "steady state allocated a new slot"
+    assert staging.stages == 6
+
+
+def test_staged_pack_equals_pack_batch():
+    """The staged layout is the SAME layout pack_batch computes into
+    fresh allocations — bit-for-bit, so ingest() and update() share
+    one jit cache entry per bucket."""
+    w, l = make_matches(77, seed=4)
+    staging = ingest.StagingBuffers(P, min_bucket=256)
+    staged = staging.stage(w, l)
+    cold = engine.pack_batch(P, w, l, min_bucket=256)
+    for got, want in zip(staged[:5], cold[:5]):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert staged.num_real == cold.num_real
+
+
+def test_steady_state_ingest_causes_zero_recompiles():
+    """The acceptance criterion, in-suite: after warmup, arbitrary
+    batch sizes through ingest() add ZERO jit-cache entries — asserted
+    via RecompileSentinel, and the staging pool stays fixed."""
+    eng = ArenaEngine(P)
+    w, l = make_matches(engine.MIN_BUCKET, seed=5)
+    eng.ingest(w[:10], l[:10])  # warmup: compiles the floor bucket
+    eng.ingest(w[:20], l[:20])  # second slot of the same bucket
+    sentinel = sanitize.RecompileSentinel(update=eng.num_compiles)
+    slots_after_warmup = eng._staging.slots_allocated
+    for n in (1, 7, 100, 255, engine.MIN_BUCKET):
+        eng.ingest(w[:n], l[:n])
+    sentinel.assert_no_new_compiles()
+    assert eng._staging.slots_allocated == slots_after_warmup
+
+
+def test_staging_rejects_shallow_depth_and_bad_ids():
+    with pytest.raises(ValueError, match="two slots"):
+        ingest.StagingBuffers(P, depth=1)
+    staging = ingest.StagingBuffers(P)
+    with pytest.raises(ValueError, match="player ids"):
+        staging.stage([0, P], [1, 2])
+
+
+# --- engine wiring ---------------------------------------------------------
+
+
+def test_ingest_rejects_bad_batch_without_state_change():
+    """Same no-half-ingest contract update() has."""
+    eng = ArenaEngine(8)
+    eng.ingest([0, 1], [2, 3])
+    before = np.asarray(eng.ratings).copy()
+    with pytest.raises(ValueError, match="player ids"):
+        eng.ingest([0, 8], [1, 2])
+    np.testing.assert_array_equal(np.asarray(eng.ratings), before)
+    assert eng.matches_ingested == 2
+
+
+def test_mixed_update_and_ingest_share_one_history():
+    """Both paths feed one match store: refits see everything no
+    matter which path ingested it."""
+    eng = ArenaEngine(P)
+    w, l = make_matches(300, seed=8)
+    eng.update(w[:100], l[:100])
+    eng.ingest(w[100:250], l[100:250])
+    eng.update(w[250:], l[250:])
+    assert eng.matches_ingested == 300
+    chunked = np.asarray(eng.refit_incremental(num_iters=25, chunk_entries=256))
+    single = np.asarray(eng.bt_strengths(num_iters=25))
+    np.testing.assert_allclose(chunked, single, atol=1e-3)
+
+
+def test_clone_is_independent():
+    csr = ingest.MergeableCSR(P, compact_threshold=64)
+    w, l = make_matches(50, seed=2)
+    csr.add(w, l)
+    snap = csr.clone()
+    csr.add(w, l)
+    assert snap.num_matches == 50 and csr.num_matches == 100
+    perm, bounds = snap.grouping()
+    assert perm.size == 100 and int(bounds[-1]) == 100

@@ -53,13 +53,16 @@ def test_docs_cite_the_live_mutant_count():
 
 
 def test_mutations_cover_every_policed_surface():
-    """bench + gate (the honesty machinery) and jaxlint (the lint rules
-    whose corpus test is itself a policed property since PR 2)."""
+    """bench + gate (the honesty machinery), jaxlint (the lint rules
+    whose corpus test is itself a policed property since PR 2), and the
+    incremental ingest layer (whose equivalence/threshold/peak-bucket
+    contracts are policed properties since PR 3)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
         "verify_reference.py",
         "arena/analysis/jaxlint.py",
+        "arena/ingest.py",
     }
 
 
@@ -81,7 +84,12 @@ def _FakeProc(returncode, stdout=""):
 def _fake_sources_only(dest):
     """Stand-in for make_copy: just the mutable sources, so the
     mutation patterns resolve without dragging the whole tree along."""
-    for name in ("bench.py", "verify_reference.py", "arena/analysis/jaxlint.py"):
+    for name in (
+        "bench.py",
+        "verify_reference.py",
+        "arena/analysis/jaxlint.py",
+        "arena/ingest.py",
+    ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)
         shutil.copy2(mutation_audit.REPO / name, target)

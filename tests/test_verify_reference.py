@@ -172,6 +172,51 @@ def test_bare_git_layout_is_flagged_vcs_metadata_only(
     assert "VERSION-CONTROL METADATA" in result["note"]
 
 
+def test_gitlink_file_git_entry_classifies_as_gitlink_shape(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """A `.git` that is a FILE is a gitlink — a `gitdir: ...` pointer
+    to a git dir OUTSIDE the mount. It must get its own shape (the
+    vcs-only playbook's `git clone <mount>` cannot work on it) and the
+    note must say to read the pointer before attempting any clone
+    (advisor finding verify_reference.py:537)."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    (ref / ".git").write_text("gitdir: /somewhere/else/worktrees/arena\n")
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT
+    assert result["manifest_shape"] == "vcs-metadata-gitlink"
+    assert "GITLINK FILE" in result["note"]
+    assert "read the pointer" in result["note"]
+    assert "git clone" in result["note"]
+    # The gitlink note replaces (not augments) the dir-shape clone advice.
+    assert "materialize the committed tree read-only" not in result["note"]
+    manifest = json.loads((fake_repo / verify_reference.MANIFEST_NAME).read_text())
+    assert manifest["shape"] == "vcs-metadata-gitlink"
+    assert "GITLINK" in manifest["comment"]
+
+
+def test_gitlink_vs_git_dir_classification_unit():
+    """The classification detail: `.git` as FILE -> gitlink shape;
+    `.git` as dir (or unknown type) -> vcs-metadata-only as before."""
+    classify = verify_reference.classify_manifest_shape
+    assert (
+        classify([{"path": ".git", "type": "file", "size": 30, "sha256": "aa"}])
+        == "vcs-metadata-gitlink"
+    )
+    assert (
+        classify(
+            [
+                {"path": ".git", "type": "dir"},
+                {"path": ".git/HEAD", "type": "file"},
+            ]
+        )
+        == "vcs-metadata-only"
+    )
+    # Entries without a type key (older manifests) keep the old verdict.
+    assert classify([{"path": ".git"}]) == "vcs-metadata-only"
+
+
 def test_git_metadata_plus_working_files_is_working_tree(
     tmp_path, fake_repo, monkeypatch, capsys
 ):
@@ -418,6 +463,60 @@ def test_scan_error_is_transient_exits_3(tmp_path, fake_repo, monkeypatch, capsy
     assert rc == verify_reference.EXIT_TRANSIENT
     assert result["observed"]["reference_entry_count"] == "scan_error"
     assert result["transient_environment_failure"] is True
+
+
+def test_mid_walk_swap_to_file_escalates_scan_error_to_drift(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """The walk started (so bench.scan reports 'scan_error', not
+    'mount_missing'), but by observation time the mount path is a
+    regular FILE: a persistent type swap that must escalate to drift
+    rc 1 IN THIS RUN — not idle as transient rc 3 until the next run
+    re-observes it (advisor finding verify_reference.py:678)."""
+    ref = tmp_path / "ref"
+    ref.write_text("was a directory when the walk began\n")
+    monkeypatch.setattr(
+        bench,
+        "scan",
+        lambda reference: {
+            "metric": "reference_scan_error",
+            "value": -1,
+            "unit": "reference_entries",
+            "vs_baseline": None,
+            "error": "OSError: mount went stale mid-iteration",
+        },
+    )
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_DRIFT == 1
+    assert result["transient_environment_failure"] is False
+    assert result["observed"]["reference_entry_count"] == "mount_not_a_directory"
+    assert result["mount_type_error"].startswith("not a directory: -")
+    assert "NOT a directory" in result["note"]
+
+
+def test_scan_error_with_healthy_dir_observation_stays_transient(
+    tmp_path, fake_repo, monkeypatch, capsys
+):
+    """The other arm of the same escalation: a mid-walk OSError while
+    the path still observes as a healthy directory is a genuine
+    transient — the re-observation must not manufacture drift."""
+    ref = tmp_path / "ref"
+    ref.mkdir()
+    monkeypatch.setattr(
+        bench,
+        "scan",
+        lambda reference: {
+            "metric": "reference_scan_error",
+            "value": -1,
+            "unit": "reference_entries",
+            "vs_baseline": None,
+            "error": "OSError: flaky",
+        },
+    )
+    rc, result = run_main(monkeypatch, capsys, ref, fake_repo)
+    assert rc == verify_reference.EXIT_TRANSIENT
+    assert result["observed"]["reference_entry_count"] == "scan_error"
+    assert "mount_type_error" not in result
 
 
 def test_file_at_mount_path_is_drift_exits_1(tmp_path, fake_repo, monkeypatch, capsys):
