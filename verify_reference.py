@@ -75,12 +75,16 @@ field and never opened, so they cannot hang the walk) / error. This is
 evidence to bootstrap the mandated SURVEY.md rewrite, so the
 obsolescence path starts from facts instead of a blank page. stdout
 stays one JSON line. The manifest (and the gate line's
-`manifest_shape`) also classifies the tree's shape: "working-tree", or
+`manifest_shape`) also classifies the tree's shape: "working-tree";
 "vcs-metadata-only" when every entry is git metadata (a bare or hidden
 .git tree — the upstream shape BASELINE.json predicts), in which case
 the note directs the reader to materialize the committed tree before
 surveying, because the absence of working files says nothing about
-capabilities.
+capabilities; or "vcs-metadata-gitlink" when the sole entry is a .git
+FILE (a `gitdir: ...` pointer), in which case the note says to read
+the pointer before attempting any `git clone` — the real git dir
+lives outside the mount, so the vcs-only clone prescription cannot
+work.
 
 The core comparison lives in `verify(reference, repo)` so bench.py can
 embed the same evidence in the driver's mandatory bench line every
@@ -169,6 +173,12 @@ COUNT_NOT_A_DIRECTORY = "mount_not_a_directory"
 # working files would wrongly conclude "still nothing here".
 MANIFEST_SHAPE_VCS_ONLY = "vcs-metadata-only"
 MANIFEST_SHAPE_WORKING_TREE = "working-tree"
+# A `.git` that is a FILE, not a directory: a gitlink — a one-line
+# `gitdir: <path>` pointer to a git dir living OUTSIDE the mount
+# (worktree/submodule packaging). Distinct from vcs-metadata-only
+# because the playbook's `git clone <mount>` prescription FAILS on it;
+# the pointer must be read first.
+MANIFEST_SHAPE_VCS_GITLINK = "vcs-metadata-gitlink"
 # The manifest walk runs AFTER the counting walk; if the mount empties
 # in between, the entries list is empty and neither non-empty shape is
 # true. A distinct shape keeps the manifest from ever claiming "a
@@ -543,11 +553,21 @@ def classify_manifest_shape(entries: list) -> str:
     An EMPTY entries list gets its own shape ("emptied-between-walks"):
     this function only runs after the counting walk saw a non-empty
     tree, so no entries means the mount changed underfoot — evidence of
-    instability, never of a working tree."""
+    instability, never of a working tree.
+
+    A `.git` that is a FILE (not a directory) is a GITLINK — a
+    `gitdir: <path>` pointer whose target lives outside the mount —
+    and gets its own shape ("vcs-metadata-gitlink"): still zero
+    working files, but the VCS-only playbook step `git clone <mount>`
+    cannot work on it, so the note must say "read the pointer first"
+    instead."""
     if not entries:
         return MANIFEST_SHAPE_EMPTIED
     top = {entry["path"].split("/", 1)[0] for entry in entries}
     if top == {".git"}:
+        git_entry = next((e for e in entries if e["path"] == ".git"), None)
+        if git_entry is not None and git_entry.get("type") == "file":
+            return MANIFEST_SHAPE_VCS_GITLINK
         return MANIFEST_SHAPE_VCS_ONLY
     if {"HEAD", "objects", "refs"} <= top and top <= BARE_GIT_DIR_NAMES:
         return MANIFEST_SHAPE_VCS_ONLY
@@ -620,6 +640,15 @@ def write_manifest(
                 "metadata — materialize the committed tree before "
                 "surveying (SURVEY_REWRITE.md, 'The bare-git shape')."
                 if shape == MANIFEST_SHAPE_VCS_ONLY
+                else ""
+            )
+            + (
+                " SHAPE WARNING: the sole entry is a .git GITLINK "
+                "FILE (a 'gitdir: ...' pointer) — the real git dir "
+                "lives outside the mount; read the pointer before "
+                "attempting any git clone (SURVEY_REWRITE.md, 'The "
+                "bare-git shape')."
+                if shape == MANIFEST_SHAPE_VCS_GITLINK
                 else ""
             )
         )
@@ -701,15 +730,19 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
     observed, sidecar_errors = gather(reference, repo, scan_result)
     count = observed["reference_entry_count"]
     mount_type_error = None
-    if count == "mount_missing_or_unreadable":
+    if count in ("mount_missing_or_unreadable", "scan_error"):
         # bench.scan's accessibility boolean folds "absent" and "wrong
         # type" together (deliberately — its metric is state-neutral).
         # The gate must not: a regular file / FIFO / symlink loop
         # sitting AT the mount path is a persistent state change, not a
         # transient failure a re-run could clear. Discriminate here so
-        # the drift entry and the exit code tell the truth. If the
-        # observation now sees a healthy directory (or plain absence),
-        # the earlier scan failure stands as transient.
+        # the drift entry and the exit code tell the truth — for BOTH
+        # inaccessible-mount sentinels: a mid-walk OSError
+        # ("scan_error") can also mean the directory was swapped for a
+        # file while the walk ran, and that swap must escalate to
+        # drift in the SAME run, not stay rc 3 until the next one. If
+        # the observation now sees a healthy directory (or plain
+        # absence), the earlier scan failure stands as transient.
         mount_state, mount_detail = observe_mount_type(reference)
         if mount_state == MOUNT_NOT_A_DIR:
             count = COUNT_NOT_A_DIRECTORY
@@ -849,6 +882,16 @@ def verify(reference: pathlib.Path, repo: pathlib.Path, scan_result: dict = None
             "files; materialize the committed tree read-only (git clone "
             "from the mount) and survey THAT (SURVEY_REWRITE.md, 'The "
             "bare-git shape')."
+        )
+    elif manifest_shape == MANIFEST_SHAPE_VCS_GITLINK:
+        note += (
+            " NOTE: the tree's sole entry is a `.git` GITLINK FILE — a "
+            "one-line `gitdir: <path>` POINTER, not a git directory. "
+            "`git clone` from the mount CANNOT work (there is no object "
+            "store here); read the pointer first (`cat <mount>/.git`), "
+            "record the pointed path, and only then decide whether a "
+            "git dir is reachable to materialize from "
+            "(SURVEY_REWRITE.md, 'The bare-git shape')."
         )
 
     result = {
