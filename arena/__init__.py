@@ -10,8 +10,12 @@ Modules:
 - `arena.engine`   — ingestion (CSR-style grouping), shape-bucketed
   batching, the stateful `ArenaEngine` with jitted donated updates.
 - `arena.ingest`   — incremental ingestion: the mergeable whole-set
-  CSR grouping (delta-sorted tail + galloping merge), double-buffered
-  reusable staging slots, and the chunked epoch layout for BT refits.
+  CSR grouping (delta-sorted tail + galloping merge, LSM-style
+  size-ratio compaction), double-buffered reusable staging slots, and
+  the chunked epoch layout for BT refits.
+- `arena.pipeline` — overlapped ingest: the background packing thread
+  behind a bounded queue (`ArenaEngine.ingest_async`/`flush`), with
+  block / drop-oldest backpressure and a lossless drain protocol.
 - `arena.sharding` — device mesh, partition-rule matching, shard_map
   data-parallel updates (CPU-mesh testable, no TPU required).
 - `arena.baseline` — the deliberately naive loop implementation the
@@ -21,6 +25,7 @@ Modules:
 
 from arena.engine import ArenaEngine, bucket_size, pack_batch, pack_epoch
 from arena.ingest import MergeableCSR, StagingBuffers, chunk_layout
+from arena.pipeline import IngestPipeline, PipelineError
 from arena.ratings import (
     bt_fit,
     bt_fit_chunked,
@@ -34,7 +39,9 @@ from arena.ratings import (
 
 __all__ = [
     "ArenaEngine",
+    "IngestPipeline",
     "MergeableCSR",
+    "PipelineError",
     "StagingBuffers",
     "bucket_size",
     "chunk_layout",

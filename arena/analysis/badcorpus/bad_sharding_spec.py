@@ -1,0 +1,28 @@
+"""jaxlint corpus: shard_map specs that disagree with the mesh.
+
+The mesh defines exactly one axis ("data"); the in_specs tuple names a
+"model" axis no mesh defines AND carries two specs for a three-argument
+function — both silent until runtime (or until an unlucky shape makes
+them loud). Rule: sharding-spec-arity."""
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+DATA_AXIS = "data"
+
+mesh = Mesh(np.array(jax.devices()), (DATA_AXIS,))
+
+
+@partial(
+    shard_map,
+    mesh=mesh,
+    in_specs=(P(DATA_AXIS), P("model")),  # unknown axis; 2 specs, 3 args
+    out_specs=P(),
+)
+def bad_sharded(a, b, c):
+    return a + b + c

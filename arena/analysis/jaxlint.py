@@ -637,6 +637,166 @@ def _check_jnp_on_host_path(ctx: ModuleContext):
                 )
 
 
+def _module_str_constants(tree) -> dict:
+    """Module-level `NAME = "literal"` bindings — how mesh axis names
+    are spelled in this repo (e.g. `DATA_AXIS = "data"`)."""
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = node.value.value
+    return out
+
+
+def _pspec_aliases(tree) -> set:
+    """Names PartitionSpec is bound to ('PartitionSpec' plus any
+    `from jax.sharding import PartitionSpec as P` alias)."""
+    names = {"PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _collect_mesh_axes(tree, str_consts):
+    """(axis-name set, known) over every `Mesh(...)` call in the module.
+
+    Axis names come from the second positional argument or the
+    `axis_names=` keyword; string constants and module-level string
+    bindings resolve, anything else makes the set unknown (known=False)
+    so the axis-name check stays quiet rather than guessing.
+    """
+    axes = set()
+    found = False
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func)
+        if fname is None or fname.split(".")[-1] != "Mesh":
+            continue
+        found = True
+        spec = node.args[1] if len(node.args) >= 2 else None
+        for kw in node.keywords:
+            if kw.arg == "axis_names":
+                spec = kw.value
+        if spec is None:
+            return set(), False
+        elts = spec.elts if isinstance(spec, (ast.Tuple, ast.List)) else [spec]
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                axes.add(e.value)
+            elif isinstance(e, ast.Name) and e.id in str_consts:
+                axes.add(str_consts[e.id])
+            else:
+                return set(), False
+    return axes, found
+
+
+def _shard_map_site(call):
+    """(kwargs, wrapped-fn node or None) if `call` applies shard_map —
+    either `shard_map(f, mesh=..., in_specs=..., out_specs=...)` or
+    `partial(shard_map, mesh=..., ...)` (the decorator idiom)."""
+    fname = dotted(call.func)
+    if fname is None:
+        return None
+    tail = fname.split(".")[-1]
+    if tail == "shard_map":
+        fn = call.args[0] if call.args else None
+        return {kw.arg: kw.value for kw in call.keywords}, fn
+    if tail == "partial" and call.args:
+        inner = dotted(call.args[0])
+        if inner and inner.split(".")[-1] == "shard_map":
+            return {kw.arg: kw.value for kw in call.keywords}, None
+    return None
+
+
+@rule(
+    "sharding-spec-arity",
+    "shard_map in_specs arity disagrees with the wrapped function, or a "
+    "PartitionSpec names a mesh axis no mesh in the module defines — the "
+    "silent class of mistake match_partition_rules only catches at runtime",
+)
+def _check_sharding_spec_arity(ctx: ModuleContext):
+    tree = ctx.tree
+    str_consts = _module_str_constants(tree)
+    axes, axes_known = _collect_mesh_axes(tree, str_consts)
+    pspec_names = _pspec_aliases(tree)
+    defs_by_name = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+    def resolve_axis(arg):
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        if isinstance(arg, ast.Name) and arg.id in str_consts:
+            return str_consts[arg.id]
+        return None  # None / unresolvable: no claim
+
+    def check_site(kws, fn_def):
+        in_specs = kws.get("in_specs")
+        if (
+            in_specs is not None
+            and isinstance(in_specs, ast.Tuple)
+            and fn_def is not None
+            and not fn_def.args.vararg
+        ):
+            nparams = len(fn_def.args.posonlyargs) + len(fn_def.args.args)
+            nspecs = len(in_specs.elts)
+            if nspecs != nparams:
+                yield ctx.finding(
+                    in_specs,
+                    "sharding-spec-arity",
+                    f"in_specs carries {nspecs} specs but the shard_mapped "
+                    f"`{fn_def.name}` takes {nparams} arguments — every "
+                    "operand needs exactly one PartitionSpec",
+                )
+        for spec_expr in (in_specs, kws.get("out_specs")):
+            if spec_expr is None or not axes_known:
+                continue
+            for node in ast.walk(spec_expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = dotted(node.func)
+                if cname is None or cname.split(".")[-1] not in pspec_names:
+                    continue
+                for arg in node.args:
+                    name = resolve_axis(arg)
+                    if name is not None and name not in axes:
+                        yield ctx.finding(
+                            node,
+                            "sharding-spec-arity",
+                            f"PartitionSpec axis {name!r} is not defined by "
+                            "any mesh in this module (mesh axes: "
+                            f"{sorted(axes)}) — sharding over it fails at "
+                            "runtime or silently replicates",
+                        )
+
+    seen_decorators = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    site = _shard_map_site(dec)
+                    if site is not None:
+                        seen_decorators.add(id(dec))
+                        kws, fn = site
+                        fn_def = defs_by_name.get(fn.id) if isinstance(fn, ast.Name) else node
+                        yield from check_site(kws, fn_def)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and id(node) not in seen_decorators:
+            site = _shard_map_site(node)
+            if site is not None:
+                kws, fn = site
+                fn_def = defs_by_name.get(fn.id) if isinstance(fn, ast.Name) else None
+                yield from check_site(kws, fn_def)
+
+
 # --- driver ---------------------------------------------------------------
 
 BADCORPUS_DIR = "badcorpus"

@@ -25,6 +25,7 @@ Keep it that way — lint must run on boxes with no accelerator stack.
 """
 
 import functools
+import threading
 from contextlib import contextmanager
 
 import jax
@@ -91,26 +92,39 @@ class RecompileSentinel:
 
     Also usable as a context manager (`with RecompileSentinel(...)`):
     enter re-snapshots, exit checks.
+
+    THREAD-AWARE since the overlapped ingest pipeline: jit caches are
+    process-global, so a compile triggered from ANY thread (the
+    pipeline's packer, a dispatching caller) moves the watched count
+    and is caught by a sentinel built on a different thread. An
+    internal lock makes snapshot()/new_compiles() atomic under
+    concurrent callers; for a deterministic verdict, check at a
+    quiescent point (after `ArenaEngine.flush()` has drained the
+    pipeline), otherwise an in-flight compile may land on either side
+    of the snapshot.
     """
 
     def __init__(self, **watched):
         if not watched:
             raise ValueError("nothing to watch")
         self._watched = watched
+        self._lock = threading.Lock()
         self.snapshot()
 
     def snapshot(self):
-        self._baseline = {k: _cache_count(v) for k, v in self._watched.items()}
+        with self._lock:
+            self._baseline = {k: _cache_count(v) for k, v in self._watched.items()}
 
     def new_compiles(self) -> dict:
         """name -> (baseline, now) for every watched fn that recompiled."""
-        out = {}
-        for name, obj in self._watched.items():
-            now = _cache_count(obj)
-            before = self._baseline[name]
-            if now != before:
-                out[name] = (before, now)
-        return out
+        with self._lock:
+            out = {}
+            for name, obj in self._watched.items():
+                now = _cache_count(obj)
+                before = self._baseline[name]
+                if now != before:
+                    out[name] = (before, now)
+            return out
 
     def assert_no_new_compiles(self):
         grew = self.new_compiles()

@@ -28,7 +28,12 @@ The host-side half of the hot path. Three jobs:
    `ingest()` packs through reusable double-buffered staging slots and
    merges the whole-set grouping incrementally, and
    `refit_incremental()` runs the chunked Bradley–Terry fit over that
-   grouping — no repack-the-world, peak bucket one chunk.
+   grouping — no repack-the-world, peak bucket one chunk. Since PR 4
+   the OVERLAPPED path (`arena/pipeline.py`) rides the same slots:
+   `ingest_async()` hands batches to a background packer thread and
+   `flush()` drains them, bit-exact to `ingest()`; sync calls
+   interleaved with async ones drain the pipeline first, so program
+   order is preserved no matter how the two are mixed.
 """
 
 from functools import partial
@@ -201,6 +206,7 @@ class ArenaEngine:
         self._ingest_mod = ingest_mod
         self._store = ingest_mod.MergeableCSR(num_players)
         self._staging = None  # built on first ingest()
+        self._pipeline = None  # built on first ingest_async()
         self._update = jax.jit(
             partial(R.elo_batch_update_sorted, k=k, scale=scale),
             donate_argnums=(0,),
@@ -223,11 +229,34 @@ class ArenaEngine:
 
     def update(self, winners, losers):
         """Ingest one batch of outcomes and apply one batched Elo round."""
+        self._drain_pipeline()
         packed = pack_batch(
             self.num_players, winners, losers, self.min_bucket, np.float32
         )
         self._store.add(winners, losers)
         return self._apply(packed)
+
+    def _ensure_staging(self):
+        if self._staging is None:
+            self._staging = self._ingest_mod.StagingBuffers(
+                self.num_players, self.min_bucket, np.float32
+            )
+        return self._staging
+
+    def _drain_pipeline(self):
+        """Barrier: finish all pending async work first, so sync calls
+        interleaved with `ingest_async` keep their program order."""
+        if self._pipeline is not None:
+            self._pipeline.flush()
+
+    def _dispatch_packed(self, packed):
+        """Apply one staged batch and retire its staging slot — the
+        dispatch half of the pipeline, and the same pairing the sync
+        path uses, so slot lifetime is identical on both."""
+        try:
+            return self._apply(packed)
+        finally:
+            self._staging.release()
 
     def ingest(self, winners, losers):
         """`update` on the incremental path: the batch is packed
@@ -238,17 +267,86 @@ class ArenaEngine:
         being re-grouped from scratch at the next refit. Identical
         rating semantics to `update` — same jitted function, same
         packed layout — pinned by tests."""
+        self._drain_pipeline()
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
         _validate_matches(self.num_players, w, l)
-        if self._staging is None:
-            self._staging = self._ingest_mod.StagingBuffers(
-                self.num_players, self.min_bucket, np.float32
-            )
+        self._ensure_staging()
         self._store.add(w, l)
         if w.shape[0] == 0:
             return self.ratings  # nothing to dispatch
-        return self._apply(self._staging.stage(w, l))
+        return self._dispatch_packed(self._staging.stage(w, l))
+
+    # --- the overlapped (async) ingest path --------------------------
+
+    def _pack_for_pipeline(self, w, l):
+        """Packer-thread half of one async batch: merge into the store,
+        fill the next staging slot. Returns None for an empty batch
+        (nothing to dispatch). block=True: if both slots of the bucket
+        are in-flight, wait for the dispatching thread to release one
+        — that wait IS the fill/dispatch overlap window."""
+        self._ensure_staging()
+        self._store.add(w, l)
+        if w.shape[0] == 0:
+            return None
+        return self._staging.stage(w, l, block=True)
+
+    def start_pipeline(self, capacity=None, policy=None):
+        """Explicitly start the overlapped-ingest pipeline (to pick a
+        queue capacity/backpressure policy); `ingest_async` starts one
+        with defaults on first use otherwise."""
+        from arena import pipeline as pipeline_mod
+
+        if self._pipeline is not None:
+            raise RuntimeError(
+                "pipeline already running; shutdown() it before starting "
+                "another"
+            )
+        kwargs = {}
+        if capacity is not None:
+            kwargs["capacity"] = capacity
+        if policy is not None:
+            kwargs["policy"] = policy
+        self._pipeline = pipeline_mod.IngestPipeline(self, **kwargs)
+        return self._pipeline
+
+    def ingest_async(self, winners, losers):
+        """`ingest` through the overlapped pipeline: the batch is
+        validated HERE (a malformed batch raises at the call site, no
+        state change) and handed to the background packer thread;
+        the rating update is dispatched by later `ingest_async`/
+        `flush()` calls on the calling thread. Rating semantics are
+        bit-exact `ingest()` — same slots, same jitted update, same
+        order — the async-ness only moves the host packing off the
+        caller's critical path. Returns the number of batches still
+        pending (0 means everything submitted so far has applied)."""
+        w = np.asarray(winners, np.int32)
+        l = np.asarray(losers, np.int32)
+        _validate_matches(self.num_players, w, l)
+        if self._pipeline is None:
+            self.start_pipeline()
+        self._pipeline.submit(w, l)
+        return self._pipeline.pending()
+
+    def flush(self):
+        """Drain the async pipeline (if any) and block until the
+        ratings buffer is ready. The ratings returned reflect every
+        `ingest_async` batch submitted before the flush."""
+        self._drain_pipeline()
+        jax.block_until_ready(self.ratings)
+        return self.ratings
+
+    def shutdown(self, drain=True):
+        """Stop the pipeline thread. drain=True (default) applies
+        everything still queued; drain=False drops raw batches (see
+        `IngestPipeline.close`). Safe to call with no pipeline; after
+        shutdown, `ingest_async` starts a fresh pipeline lazily."""
+        if self._pipeline is not None:
+            try:
+                self._pipeline.close(drain=drain)
+            finally:
+                self._pipeline = None
+        return self.ratings
 
     def refit_incremental(self, num_iters=50, prior=0.1, chunk_entries=None):
         """Chunked Bradley–Terry refit over the incremental grouping.
@@ -260,6 +358,7 @@ class ArenaEngine:
         layout). Same model, same fixed point as `bt_strengths`;
         equivalence is property-tested.
         """
+        self._drain_pipeline()
         if self._store.num_matches == 0:
             raise ValueError("no matches ingested")
         if chunk_entries is None:
@@ -287,7 +386,8 @@ class ArenaEngine:
         return self._update._cache_size()
 
     def leaderboard(self, top_k=None):
-        """(player_id, rating) pairs, best first."""
+        """(player_id, rating) pairs, best first (async work drained)."""
+        self._drain_pipeline()
         r = np.asarray(self.ratings)
         order = np.argsort(-r)
         if top_k is not None:
@@ -301,6 +401,7 @@ class ArenaEngine:
         the standard periodic companion to online ratings. Runs as one
         fused scan over `num_iters` MM steps (see `ratings.bt_fit`).
         """
+        self._drain_pipeline()
         if self._store.num_matches == 0:
             raise ValueError("no matches ingested")
         w = self._store.winners()

@@ -14,11 +14,16 @@ match stream:
    ascending player id, `_pos` the matching entry positions) plus a
    small unsorted delta tail of recently added batches. Merging a new
    batch costs an O(d log d) sort of just the delta; when the tail
-   exceeds `compact_threshold` entries, ONE linear galloping merge
-   (`_gallop_merge`: vectorized binary/exponential search of the
-   sorted tail into the runs, then two fancy-index copies) folds it
-   into the main runs — the full O(N log N) re-sort never happens
-   again after the first build. Entry positions use the INTERLEAVED
+   outgrows the LSM-style size-ratio limit (main/size_ratio entries,
+   floored at `compact_threshold` — so merge cost stays amortized
+   O(size_ratio) per entry as the base grows unbounded), ONE linear
+   galloping merge (`_gallop_merge`: vectorized binary/exponential
+   search of the sorted tail into the runs, then two fancy-index
+   copies) folds it into the main runs — the full O(N log N) re-sort
+   never happens again after the first build. All mutations and
+   `clone()` run under one internal lock, so the pipeline's packer
+   thread and a concurrent snapshot can never observe a
+   mid-compaction structure. Entry positions use the INTERLEAVED
    convention: match i's winner entry is position 2i, its loser entry
    2i+1, so previously-merged positions never shift when matches are
    appended (the concat([winners, losers]) convention of
@@ -53,6 +58,9 @@ device-transfer boundary), matching the ingest discipline jaxlint's
 `jnp-on-host-path` rule enforces.
 """
 
+import threading
+from collections import deque
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -64,12 +72,21 @@ from arena.engine import (
     bucket_size,
 )
 
-# Tail entries (2 per match) tolerated before a galloping merge folds
-# the delta into the main runs. Compaction is O(main + tail); a larger
-# threshold amortizes it over more batches at the price of a bigger
-# merge at grouping() time. 16384 entries = 8192 matches, one default
-# bench batch.
+# Floor on the tail entries (2 per match) tolerated before a galloping
+# merge folds the delta into the main runs. The live limit is
+# LSM-style size-ratio: compact when the tail outgrows main/size_ratio
+# entries (see `MergeableCSR._compact_limit`), with this floor keeping
+# tiny early sets from compacting on every add. 16384 entries = 8192
+# matches, one default bench batch.
 DEFAULT_COMPACT_THRESHOLD = 16_384
+
+# LSM size-ratio: the delta tail may grow to main/size_ratio entries
+# before a compaction folds it in. Each merge is O(main + tail) and is
+# amortized over >= main/size_ratio newly added entries, so merge cost
+# stays amortized O(size_ratio) per entry NO MATTER how large the base
+# grows — the fixed-count threshold this replaces degraded to one
+# O(main) merge per fixed-size batch as main grew unbounded.
+DEFAULT_SIZE_RATIO = 8
 
 # Sorted-order entries per chunk in the epoch layout handed to the
 # chunked Bradley-Terry fit (2 entries per match -> 8192 matches).
@@ -116,13 +133,27 @@ class MergeableCSR:
     drop-in for `sorted_segment_sum` over interleaved values.
     """
 
-    def __init__(self, num_players, compact_threshold=DEFAULT_COMPACT_THRESHOLD):
+    def __init__(
+        self,
+        num_players,
+        compact_threshold=DEFAULT_COMPACT_THRESHOLD,
+        size_ratio=DEFAULT_SIZE_RATIO,
+    ):
         if num_players < 2:
             raise ValueError("an arena needs at least two players")
+        if size_ratio < 1:
+            raise ValueError(f"size_ratio must be >= 1, got {size_ratio}")
         self.num_players = num_players
         self.compact_threshold = compact_threshold
+        self.size_ratio = size_ratio
         self.num_matches = 0
         self.compactions = 0
+        # One lock covers every mutation AND clone(): the pipeline's
+        # packer thread merges batches under it, so a concurrent
+        # clone()/grouping() from another thread always snapshots a
+        # consistent structure (never mid-compaction). RLock because
+        # grouping() compacts and add() may compact.
+        self._lock = threading.RLock()
         # Main sorted runs: keys ascending player id, pos the
         # interleaved entry positions in that order.
         self._keys = np.empty(0, np.int32)
@@ -158,9 +189,21 @@ class MergeableCSR:
         """Entries (2 per match) waiting in the unmerged delta tail."""
         return self._tail_entries
 
+    def _compact_limit(self):
+        """LSM-style size-ratio bound on the delta tail: compact when
+        the tail outgrows main/size_ratio entries, floored at
+        compact_threshold so tiny early sets do not pay a merge per
+        add. Amortized merge cost per entry is O(size_ratio) at ANY
+        base size — the point of the policy."""
+        return max(self.compact_threshold, self._keys.size // self.size_ratio)
+
     def add(self, winners, losers):
         """Merge one batch: O(d log d) sort of the delta, deferred
         linear galloping merge. Returns the number of matches added."""
+        with self._lock:
+            return self._add_locked(winners, losers)
+
+    def _add_locked(self, winners, losers):
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
         _validate_matches(self.num_players, w, l)
@@ -179,14 +222,18 @@ class MergeableCSR:
         self._tail_pos.append(pos[order])
         self._tail_entries += 2 * d
         self.num_matches += d
-        if self._tail_entries > self.compact_threshold:
-            self.compact()
+        if self._tail_entries > self._compact_limit():
+            self._compact_locked()
         return d
 
     def compact(self):
         """Fold the delta tail into the main runs: one stable sort of
         the (small) tail, one linear galloping merge. No-op when the
         tail is empty."""
+        with self._lock:
+            self._compact_locked()
+
+    def _compact_locked(self):
         if not self._tail_keys:
             return
         tail_k = np.concatenate(self._tail_keys)
@@ -207,28 +254,37 @@ class MergeableCSR:
         order; `bounds[p]` is player p's start offset (length
         num_players+1). Compacts first, so the returned view IS the
         main runs — callers pay at most one tail merge, never a full
-        re-sort.
+        re-sort. The returned arrays are a consistent snapshot: a later
+        concurrent compaction builds NEW arrays, it never mutates
+        these in place.
         """
-        self.compact()
-        bounds = np.searchsorted(
-            self._keys, np.arange(self.num_players + 1), side="left"
-        ).astype(np.int32)
-        return self._pos, bounds
+        with self._lock:
+            self._compact_locked()
+            bounds = np.searchsorted(
+                self._keys, np.arange(self.num_players + 1), side="left"
+            ).astype(np.int32)
+            return self._pos, bounds
 
     def clone(self):
         """Independent copy (bench baseline-vs-delta runs; also the
-        seed of the snapshot/restore the serving layer will need)."""
-        other = MergeableCSR(self.num_players, self.compact_threshold)
-        other.num_matches = self.num_matches
-        other.compactions = self.compactions
-        other._keys = self._keys.copy()
-        other._pos = self._pos.copy()
-        other._tail_keys = [run.copy() for run in self._tail_keys]
-        other._tail_pos = [run.copy() for run in self._tail_pos]
-        other._tail_entries = self._tail_entries
-        other._w = self._w.copy()
-        other._l = self._l.copy()
-        return other
+        seed of the snapshot/restore the serving layer will need).
+        Snapshots under the same lock the pipeline's packer merges
+        under, so a clone taken while a compaction is in flight on
+        another thread is still a consistent structure."""
+        with self._lock:
+            other = MergeableCSR(
+                self.num_players, self.compact_threshold, self.size_ratio
+            )
+            other.num_matches = self.num_matches
+            other.compactions = self.compactions
+            other._keys = self._keys.copy()
+            other._pos = self._pos.copy()
+            other._tail_keys = [run.copy() for run in self._tail_keys]
+            other._tail_pos = [run.copy() for run in self._tail_pos]
+            other._tail_entries = self._tail_entries
+            other._w = self._w.copy()
+            other._l = self._l.copy()
+            return other
 
 
 class _Slot:
@@ -242,6 +298,7 @@ class _Slot:
         self.sorted_keys = np.empty(2 * bucket, np.int32)
         self.perm = np.empty(2 * bucket, np.int32)
         self.bounds = np.empty(num_players + 1, np.int32)
+        self.in_flight = False
 
 
 class StagingBuffers:
@@ -255,6 +312,15 @@ class StagingBuffers:
     state allocates nothing: `slots_allocated` stops growing after
     warmup, and because slot shapes are exactly the pow2 buckets the
     jit cache stops growing too (the `RecompileSentinel` contract).
+
+    Slot lifetime is EXPLICIT, not caller discipline: `stage` marks
+    the filled slot in-flight and `release()` retires the oldest one
+    (call it once the dispatch that consumed the slot has been issued
+    — `ArenaEngine` pairs the two in `_dispatch_packed`). Rotating
+    into a slot that is still in-flight raises by default instead of
+    silently overwriting the arrays a live dispatch was staged from;
+    `stage(..., block=True)` waits for the slot instead (what the
+    pipeline's packer thread does while the main thread drains).
     """
 
     def __init__(self, num_players, min_bucket=MIN_BUCKET, dtype=np.float32, depth=2):
@@ -266,30 +332,60 @@ class StagingBuffers:
         self._dtype = dtype
         self._rings = {}  # bucket -> list of slots
         self._next = {}  # bucket -> rotation index
+        self._cond = threading.Condition()
+        self._inflight = deque()  # slots in stage order, until release()
         self.slots_allocated = 0
         self.stages = 0
 
-    def _acquire(self, bucket):
-        ring = self._rings.get(bucket)
-        if ring is None:
-            ring = []
-            self._rings[bucket] = ring
-            self._next[bucket] = 0
-        if len(ring) < self.depth:
-            ring.append(_Slot(bucket, self.num_players, self._dtype))
-            self.slots_allocated += 1
-        slot = ring[self._next[bucket] % len(ring)]
-        self._next[bucket] = (self._next[bucket] + 1) % self.depth
-        return slot
+    def in_flight(self):
+        """Slots staged but not yet release()d."""
+        with self._cond:
+            return len(self._inflight)
 
-    def stage(self, winners, losers):
+    def _acquire(self, bucket, block):
+        with self._cond:
+            ring = self._rings.get(bucket)
+            if ring is None:
+                ring = []
+                self._rings[bucket] = ring
+                self._next[bucket] = 0
+            if len(ring) < self.depth:
+                slot = _Slot(bucket, self.num_players, self._dtype)
+                ring.append(slot)
+                self.slots_allocated += 1
+            else:
+                slot = ring[self._next[bucket] % len(ring)]
+                if slot.in_flight and not block:
+                    raise RuntimeError(
+                        f"all {self.depth} staging slots of bucket {bucket} "
+                        "are in-flight; rotating now would overwrite arrays "
+                        "a live dispatch was staged from — release() the "
+                        "oldest dispatch first (or stage with block=True)"
+                    )
+                while slot.in_flight:
+                    self._cond.wait()
+            self._next[bucket] = (self._next[bucket] + 1) % self.depth
+            slot.in_flight = True
+            self._inflight.append(slot)
+            return slot
+
+    def release(self):
+        """Retire the OLDEST in-flight slot (dispatches are FIFO)."""
+        with self._cond:
+            if not self._inflight:
+                raise RuntimeError("no in-flight staging slot to release")
+            slot = self._inflight.popleft()
+            slot.in_flight = False
+            self._cond.notify_all()
+
+    def stage(self, winners, losers, block=False):
         """Pack one validated batch through a reusable slot."""
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
         _validate_matches(self.num_players, w, l)
         n = w.shape[0]
         b = bucket_size(n, self.min_bucket)
-        slot = self._acquire(b)
+        slot = self._acquire(b, block)
         slot.w[:n] = w
         slot.w[n:] = 0
         slot.l[:n] = l

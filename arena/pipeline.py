@@ -1,0 +1,263 @@
+"""Overlapped ingest: a background packing thread behind a bounded queue.
+
+PR 3 made the host side of ingest incremental (mergeable CSR grouping,
+reusable double-buffered staging slots), but fill and dispatch still
+run on ONE thread: every `ArenaEngine.ingest()` call pays the NumPy
+packing cost (delta sort + slot grouping, the dominant host cost) and
+the device dispatch back to back. This module splits them across the
+thread boundary the double buffer was built for:
+
+- **`IngestPipeline`** owns a background PACKER thread. `submit()`
+  enqueues a validated raw batch on a bounded ingest queue; the packer
+  pops batches in FIFO order, merges each into the engine's mergeable
+  CSR store and fills the next `StagingBuffers` slot (all host-side
+  NumPy), and hands the staged `PackedBatch` to a ready queue. The
+  DISPATCH half — the jitted rating update — runs on whichever thread
+  calls `submit()`/`flush()`/`close()` (in practice the main thread),
+  so the packer fills one slot while the main thread dispatches the
+  other. Order is preserved end to end (one packer, FIFO queues, one
+  dispatch at a time), so the ratings are BIT-EXACT equal to the
+  synchronous `ingest()` path — same staged layout, same jitted
+  function, same sequence (pinned by tests and by the bench's hard
+  equivalence gate).
+
+- **Backpressure**: the ingest queue is bounded (`capacity`). When it
+  is full, the `"block"` policy makes `submit()` dispatch ready work
+  and wait for space (lossless — the default), while `"drop-oldest"`
+  evicts the oldest still-raw batch and counts it in
+  `dropped_batches`/`dropped_matches` (bounded-staleness traffic
+  shedding; a dropped batch never touched the match store, so history
+  and ratings stay consistent). Batches the packer has already merged
+  are ALWAYS dispatched — only raw, un-merged batches can be dropped.
+
+- **Shutdown/drain**: `flush()` blocks until everything submitted has
+  been packed and dispatched. `close(drain=True)` (the default)
+  flushes, then stops and joins the packer; `close(drain=False)` drops
+  the raw queue first (counted), still dispatches everything already
+  past the store merge, then joins. Every blocking wait re-checks
+  packer liveness, so a dead or never-started packer thread raises
+  `PipelineError` instead of hanging the caller.
+
+On this image's single host core the two threads share one CPU, so the
+overlap cannot beat the synchronous path in wall clock (the bench
+reports what it measures, with `host_cores` in the line); the
+pipeline's value here is the concurrency-correct shape — bounded queue,
+slot lifetime discipline, drain protocol — that a real accelerator
+host needs, where device dispatch is idle host time the packer can use.
+"""
+
+import threading
+import time
+from collections import deque
+
+POLICY_BLOCK = "block"
+POLICY_DROP_OLDEST = "drop-oldest"
+POLICIES = (POLICY_BLOCK, POLICY_DROP_OLDEST)
+
+# Raw batches tolerated in the ingest queue before backpressure kicks
+# in. Small by design: the queue bounds rating staleness, not memory.
+DEFAULT_QUEUE_CAPACITY = 8
+
+# Wait quantum for every blocking loop: each wakeup re-checks packer
+# liveness and recorded errors, so no caller can hang on a dead thread.
+_WAIT_S = 0.05
+
+
+class PipelineError(RuntimeError):
+    """The pipeline cannot make progress (packer dead or errored)."""
+
+
+class IngestPipeline:
+    """Background packing thread + bounded ingest queue for one engine.
+
+    Built lazily by `ArenaEngine.ingest_async()` (or explicitly via
+    `ArenaEngine.start_pipeline(capacity=..., policy=...)`). The
+    pipeline owns no rating state: it moves batches through the
+    engine's own store/staging/update path, which is what makes the
+    async ratings bit-exact to the sync ones.
+    """
+
+    def __init__(self, engine, capacity=DEFAULT_QUEUE_CAPACITY, policy=POLICY_BLOCK):
+        if capacity < 1:
+            raise ValueError(f"queue capacity must be >= 1, got {capacity}")
+        if policy not in POLICIES:
+            raise ValueError(f"unknown queue policy {policy!r}; pick one of {POLICIES}")
+        self._eng = engine
+        self.capacity = capacity
+        self.policy = policy
+        self._cv = threading.Condition()
+        self._raw = deque()  # validated (winners, losers), not yet packed
+        self._ready = deque()  # staged PackedBatch, not yet dispatched
+        # Serializes pop-from-ready + apply so concurrent dispatchers
+        # (submit draining while flush drains) keep FIFO order.
+        self._dispatch_lock = threading.Lock()
+        self._closed = False
+        self._packing = False  # packer holds a popped batch right now
+        self._error = None
+        self.submitted = 0
+        self.completed = 0
+        self.dropped_batches = 0
+        self.dropped_matches = 0
+        # Host-pack vs device-dispatch breakdown (the bench reports it).
+        self.host_pack_s = 0.0
+        self.dispatch_s = 0.0
+        self._thread = threading.Thread(
+            target=self._pack_loop, name="arena-ingest-packer", daemon=True
+        )
+        self._thread.start()
+
+    # --- accounting --------------------------------------------------
+
+    def pending(self):
+        """Batches submitted but not yet dispatched (or dropped)."""
+        with self._cv:
+            return self._pending_locked()
+
+    def _pending_locked(self):
+        return self.submitted - self.completed - self.dropped_batches
+
+    def _raise_if_failed_locked(self):
+        if self._error is not None:
+            raise PipelineError(
+                f"ingest pipeline failed in the packer thread: {self._error!r}"
+            ) from self._error
+
+    def _check_packer_locked(self):
+        """Raise if pending work needs a packer that is not running."""
+        self._raise_if_failed_locked()
+        if (self._raw or self._packing) and not self._thread.is_alive():
+            raise PipelineError(
+                "packer thread is not running but batches are queued; "
+                "the pipeline cannot drain"
+            )
+
+    # --- producer side ----------------------------------------------
+
+    def submit(self, winners, losers):
+        """Enqueue one VALIDATED batch (int32 arrays, ids in range).
+
+        Validation happens in `ArenaEngine.ingest_async` on the calling
+        thread so a malformed batch raises at the call site with no
+        state change. While waiting on a full queue (block policy) the
+        caller dispatches ready work — backpressure can never deadlock
+        against a packer waiting for a staging slot.
+        """
+        while True:
+            with self._cv:
+                if self._closed:
+                    raise PipelineError("pipeline is closed; start a new one")
+                self._raise_if_failed_locked()
+                if len(self._raw) < self.capacity:
+                    self._raw.append((winners, losers))
+                    self.submitted += 1
+                    self._cv.notify_all()
+                    break
+                if self.policy == POLICY_DROP_OLDEST:
+                    dw, _dl = self._raw.popleft()
+                    self.dropped_batches += 1
+                    self.dropped_matches += int(dw.shape[0])
+                    continue
+                self._check_packer_locked()
+            # Block policy, queue full: make progress instead of
+            # spinning — dispatch one ready batch if there is one
+            # (frees a staging slot, letting the packer advance).
+            if not self._dispatch_one():
+                with self._cv:
+                    self._cv.wait(_WAIT_S)
+        # Overlap: opportunistically dispatch whatever the packer has
+        # already staged while the caller is here anyway.
+        while self._dispatch_one():
+            pass
+
+    # --- dispatch side (runs on the submitting/flushing thread) ------
+
+    def _dispatch_one(self):
+        """Dispatch the oldest ready batch. Returns True if one ran."""
+        with self._dispatch_lock:
+            with self._cv:
+                if not self._ready:
+                    return False
+                packed = self._ready.popleft()
+            t0 = time.perf_counter()
+            try:
+                self._eng._dispatch_packed(packed)
+            finally:
+                self.dispatch_s += time.perf_counter() - t0
+                with self._cv:
+                    self.completed += 1
+                    self._cv.notify_all()
+        return True
+
+    def flush(self):
+        """Block until every submitted batch is packed AND dispatched."""
+        while True:
+            if self._dispatch_one():
+                continue
+            with self._cv:
+                self._raise_if_failed_locked()
+                if self._pending_locked() == 0:
+                    return
+                self._check_packer_locked()
+                self._cv.wait(_WAIT_S)
+
+    def close(self, drain=True):
+        """Stop the pipeline and join the packer thread.
+
+        drain=True processes everything still queued (lossless
+        shutdown). drain=False drops batches still in the RAW queue
+        (counted in dropped_batches) — but batches the packer already
+        merged into the match store are always dispatched, so the
+        store and the ratings can never disagree about which matches
+        happened.
+        """
+        with self._cv:
+            self._closed = True
+            if not drain:
+                while self._raw:
+                    dw, _dl = self._raw.popleft()
+                    self.dropped_batches += 1
+                    self.dropped_matches += int(dw.shape[0])
+            self._cv.notify_all()
+        try:
+            self.flush()
+        finally:
+            with self._cv:
+                self._cv.notify_all()
+            self._thread.join(timeout=10.0)
+
+    # --- the packer thread -------------------------------------------
+
+    def _pack_loop(self):
+        while True:
+            with self._cv:
+                while not self._raw and not self._closed:
+                    self._cv.wait()
+                if not self._raw:
+                    return  # closed and fully drained
+                w, l = self._raw.popleft()
+                self._packing = True
+                self._cv.notify_all()  # queue space for blocked submits
+            try:
+                t0 = time.perf_counter()
+                packed = self._eng._pack_for_pipeline(w, l)
+                self.host_pack_s += time.perf_counter() - t0
+            except BaseException as exc:  # noqa: BLE001 — must surface on the caller
+                with self._cv:
+                    self._error = exc
+                    self._packing = False
+                    # The failed batch and everything behind it is
+                    # dropped; flush()/submit() re-raise on next call.
+                    self.dropped_batches += 1 + len(self._raw)
+                    self.dropped_matches += int(w.shape[0]) + sum(
+                        int(rw.shape[0]) for rw, _rl in self._raw
+                    )
+                    self._raw.clear()
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                if packed is not None:
+                    self._ready.append(packed)
+                else:
+                    self.completed += 1  # empty batch: nothing to dispatch
+                self._packing = False
+                self._cv.notify_all()

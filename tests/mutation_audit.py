@@ -250,9 +250,9 @@ MUTATIONS = (
     (
         "ingest-compaction-threshold-inverted",
         "arena/ingest.py",
-        "        if self._tail_entries > self.compact_threshold:",
-        "        if self._tail_entries < self.compact_threshold:",
-        "the compaction threshold gates WHEN the galloping merge runs: "
+        "        if self._tail_entries > self._compact_limit():",
+        "        if self._tail_entries < self._compact_limit():",
+        "the compaction limit gates WHEN the galloping merge runs: "
         "inverted, every small add pays a merge (or the tail never folds) — "
         "killed by test_compaction_respects_threshold",
     ),
@@ -265,6 +265,50 @@ MUTATIONS = (
         "padding everything back into one pow2 bucket reintroduces the 2x "
         "memory cliff — killed by "
         "test_chunk_layout_peak_bucket_strictly_smaller_than_pow2",
+    ),
+    (
+        "ingest-size-ratio-check-inverted",
+        "arena/ingest.py",
+        "        return max(self.compact_threshold, self._keys.size // self.size_ratio)",
+        "        return min(self.compact_threshold, self._keys.size // self.size_ratio)",
+        "the LSM size-ratio policy must let the tolerated tail GROW with the "
+        "main runs (amortized O(size_ratio) merge cost per entry); min() "
+        "collapses the limit back to the fixed floor, re-introducing one "
+        "O(main) merge per batch as the base grows — killed by "
+        "test_size_ratio_policy_scales_with_base",
+    ),
+    (
+        "pipeline-packer-thread-never-started",
+        "arena/pipeline.py",
+        "        self._thread.start()",
+        "        pass  # packer thread intentionally not started",
+        "the overlapped path's packing must actually run on the background "
+        "thread; never starting it would make every ingest_async silently "
+        "queue forever — the liveness check turns that into PipelineError at "
+        "the next flush, killed by test_async_matches_sync_bit_exact (and "
+        "every other pipeline lifecycle test)",
+    ),
+    (
+        "pipeline-equivalence-gate-skipped",
+        "arena/bench_arena.py",
+        "    if not max_async_diff < tol:\n"
+        "        raise EquivalenceError(max_async_diff, tol)\n"
+        "    max_cold_diff = float(np.abs(r_async - r_cold).max())\n"
+        "    if not max_cold_diff < tol:\n"
+        "        raise EquivalenceError(max_cold_diff, tol)",
+        "    if False:\n"
+        "        raise EquivalenceError(max_async_diff, tol)\n"
+        "    max_cold_diff = float(np.abs(r_async - r_cold).max())\n"
+        "    if False:\n"
+        "        raise EquivalenceError(max_cold_diff, tol)",
+        "the bench's hard equivalence gate must cover the ASYNC path — BOTH "
+        "comparisons (async vs sync, async vs cold replay); with the whole "
+        "gate skipped, a diverging pipeline could still report an overlap "
+        "speedup — killed by "
+        "test_pipeline_bench_equivalence_gate_extends_to_async_path (tol 0 "
+        "must exit rc 2, never rc 0). An earlier single-comparison version "
+        "of this mutant SURVIVED the audit (the cold gate masked the async "
+        "gate at tol 0) — the pattern deliberately covers the full block",
     ),
     (
         "lint-donation-poisoning-dropped",

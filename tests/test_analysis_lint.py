@@ -34,6 +34,7 @@ CORPUS_EXPECTED = {
     "bad_use_after_donate.py": {"use-after-donate"},
     "bad_timing.py": {"timing-without-block"},
     "bad_jnp_host.py": {"jnp-on-host-path"},
+    "bad_sharding_spec.py": {"sharding-spec-arity"},
 }
 
 
@@ -73,17 +74,60 @@ def test_host_sync_rule_names_each_call_form():
         assert call_form in messages, f"host-sync rule no longer flags {call_form}"
 
 
-def test_default_targets_cover_the_ingest_module():
-    """The six rules gate the NEW hot path too: arena/ingest.py must be
-    inside the default-target walk (so `python -m arena.analysis` and
-    the clean-tree test both lint it) and must itself lint clean."""
+def test_default_targets_cover_the_ingest_and_pipeline_modules():
+    """The seven rules gate every NEW hot path: arena/ingest.py and
+    arena/pipeline.py must be inside the default-target walk (so
+    `python -m arena.analysis` and the clean-tree test both lint them)
+    and must themselves lint clean."""
     walked = {
         str(f) for f in jaxlint.iter_python_files(jaxlint.default_targets())
     }
-    ingest_path = str(REPO / "arena" / "ingest.py")
-    assert ingest_path in walked
-    findings = jaxlint.lint_paths([ingest_path])
-    assert findings == [], "\n".join(f.format() for f in findings)
+    for mod in ("ingest.py", "pipeline.py"):
+        path = str(REPO / "arena" / mod)
+        assert path in walked, f"default targets no longer cover arena/{mod}"
+        findings = jaxlint.lint_paths([path])
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_sharding_spec_rule_flags_both_failure_modes():
+    """Both halves of sharding-spec-arity must fire on the corpus
+    file: the undefined-axis finding AND the in_specs/function arity
+    mismatch — membership per failure mode so neither half can rot."""
+    findings = jaxlint.lint_paths([str(CORPUS / "bad_sharding_spec.py")])
+    messages = "\n".join(f.message for f in findings)
+    assert "'model'" in messages, "undefined-axis half no longer fires"
+    assert "2 specs" in messages and "3 arguments" in messages, (
+        "arity half no longer fires"
+    )
+
+
+@pytest.mark.parametrize("good", [
+    # The repo's own idiom: axis name behind a module constant, specs
+    # matching the wrapped function's arity.
+    "from functools import partial\n"
+    "import numpy as np\n"
+    "import jax\n"
+    "from jax.experimental.shard_map import shard_map\n"
+    "from jax.sharding import Mesh\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "AXIS = 'data'\n"
+    "mesh = Mesh(np.array(jax.devices()), (AXIS,))\n"
+    "@partial(shard_map, mesh=mesh, in_specs=(P(), P(AXIS)), out_specs=P())\n"
+    "def ok(r, w):\n"
+    "    return r + w\n",
+    # No mesh constructed in this module: axis names are unknowable,
+    # the rule must stay quiet rather than guess.
+    "from functools import partial\n"
+    "from jax.experimental.shard_map import shard_map\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "def build(mesh):\n"
+    "    @partial(shard_map, mesh=mesh, in_specs=(P('model'),), out_specs=P())\n"
+    "    def ok(x):\n"
+    "        return x\n"
+    "    return ok\n",
+])
+def test_sharding_spec_rule_sanctioned_patterns(good):
+    assert jaxlint.lint_source(good, "ok.py") == []
 
 
 def test_default_walk_skips_the_corpus():

@@ -153,6 +153,76 @@ def test_ingest_bench_full_size_hits_5x():
     assert result["bt"]["chunked_peak_entries"] < result["bt"]["single_bucket_entries"]
 
 
+PIPELINE_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "pipeline",
+    "ARENA_BENCH_MATCHES": "20000",
+    "ARENA_BENCH_DELTA": "2000",
+    "ARENA_BENCH_STREAM_BATCHES": "4",
+    "ARENA_BENCH_PLAYERS": "64",
+    "ARENA_BENCH_BATCH": "2048",
+    "ARENA_BENCH_REPEATS": "2",
+}
+
+
+def test_pipeline_bench_smoke_contract():
+    """ARENA_BENCH_MODE=pipeline through the real entrypoint: one JSON
+    line, rc 0, the arena_pipeline metric with the async ratings
+    BIT-EXACT to sync (max_rating_diff 0.0 — same slots, same jitted
+    update, same order), zero steady-state compiles with the packer
+    thread running, nothing dropped under the block policy, and the
+    host-pack vs device-dispatch breakdown populated."""
+    result = run_bench(PIPELINE_SMOKE_ENV)
+    assert result["metric"] == "arena_pipeline"
+    assert result["unit"] == "x_vs_sync_ingest"
+    assert result["equivalence_ok"] is True
+    assert result["value"] > 0
+    assert result["max_rating_diff"] == 0.0
+    assert result["max_rating_diff_vs_cold"] < 0.5
+    assert result["pipeline"]["steady_state_new_compiles"] == 0
+    assert result["pipeline"]["dropped_batches"] == 0
+    assert result["pipeline"]["host_pack_s"] > 0
+    assert result["pipeline"]["dispatch_s"] > 0
+    assert result["params"]["host_cores"] >= 1
+    assert result["params"]["policy"] == "block"
+
+
+def test_pipeline_bench_equivalence_gate_extends_to_async_path():
+    """The hard gate covers the ASYNC path: with the tolerance forced
+    to 0 even a bit-exact run trips it (no diff is < 0), emitting the
+    distinct equivalence-failure line (pipeline-mode unit, no speedup
+    fields) and rc 2 — so the gate being skipped in pipeline mode is
+    loudly visible (the mutation audit carries exactly that mutant)."""
+    result = run_bench(
+        {**PIPELINE_SMOKE_ENV, "ARENA_BENCH_TOL": "0"}, expect_rc=2
+    )
+    assert result["metric"] == "arena_bench_equivalence_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "x_vs_sync_ingest"
+    assert result["tolerance"] == 0.0
+    assert "exceeds tolerance" in result["error"]
+    assert "pipeline" not in result and "bt" not in result
+
+
+@pytest.mark.slow
+def test_pipeline_bench_full_size_streams_clean():
+    """The full-size overlapped run through the real entrypoint: the
+    equivalence gate, the recompile sentinel, and lossless streaming
+    all hold at 100k base / 10k streamed batches. Deliberately NO
+    speedup floor: on this 1-core image the packer and dispatcher
+    share one CPU, so the overlap cannot beat sync wall-clock (the
+    line's `note` and `host_cores` record that); the measured value is
+    reported, not asserted against hardware that cannot show it."""
+    result = run_bench({"ARENA_BENCH_MODE": "pipeline"}, timeout=600)
+    assert result["metric"] == "arena_pipeline"
+    assert result["params"]["base_matches"] == 100_000
+    assert result["params"]["stream_batch"] == 10_000
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["pipeline"]["steady_state_new_compiles"] == 0
+    assert result["pipeline"]["dropped_batches"] == 0
+    assert result["value"] > 0.5
+
+
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     """The hard gate: with the tolerance forced to 0 the (real, tiny)
     float32-vs-float64 divergence trips it — one JSON line carrying the

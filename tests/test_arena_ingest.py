@@ -165,6 +165,46 @@ def test_compaction_respects_threshold():
     assert perm.size == 2 * 55 and int(bounds[-1]) == 2 * 55
 
 
+def test_size_ratio_policy_scales_with_base():
+    """The LSM contract: the compaction limit is
+    max(compact_threshold, main_entries // size_ratio), so the tail a
+    big base tolerates GROWS with the base — merge cost stays
+    amortized O(size_ratio) per entry instead of one O(main) merge per
+    fixed-size batch. Kills the inverted-size-ratio mutant (min
+    collapses the limit back to the floor: the mid-size add below
+    would compact)."""
+    csr = ingest.MergeableCSR(P, compact_threshold=64, size_ratio=4)
+    w, l = make_matches(1000, seed=11)
+    csr.add(w, l)  # 2000 entries > floor: compacts during the add
+    assert csr.compactions == 1 and csr.tail_entries == 0
+    assert csr._compact_limit() == 500  # main/size_ratio beats the floor
+    w2, l2 = make_matches(200, seed=12)
+    csr.add(w2, l2)  # tail 400 <= 500: pending, even though 400 > floor
+    assert csr.compactions == 1
+    assert csr.tail_entries == 400
+    w3, l3 = make_matches(60, seed=13)
+    csr.add(w3, l3)  # tail 520 > 500: folds
+    assert csr.compactions == 2
+    assert csr.tail_entries == 0
+    # Exactness across the policy boundary, same as every other split.
+    vals = np.repeat(np.arange(1260, dtype=np.float32), 2)
+    got = segment_sums_via(csr, vals)
+    allw = np.concatenate([w, w2, w3])
+    alll = np.concatenate([l, l2, l3])
+    want = np.asarray(
+        jax.ops.segment_sum(
+            jnp.asarray(vals), jnp.asarray(interleaved_keys(allw, alll)),
+            num_segments=P,
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_size_ratio_rejects_degenerate_ratio():
+    with pytest.raises(ValueError, match="size_ratio"):
+        ingest.MergeableCSR(P, size_ratio=0)
+
+
 def test_galloping_merge_preserves_every_entry():
     """Compaction must MERGE the delta tail, never drop it: every
     interleaved entry position survives exactly once and the merged
@@ -234,8 +274,9 @@ def test_chunk_layout_rejects_degenerate_inputs():
 def test_staging_double_buffers_and_stops_allocating():
     """Two slots per bucket, rotated: consecutive stages of the same
     bucket use DIFFERENT host arrays (the in-flight dispatch's source
-    is never overwritten), the third reuses the first, and after both
-    slots exist steady-state traffic allocates nothing."""
+    is never overwritten), and after both slots exist steady-state
+    traffic allocates nothing. Slot lifetime is explicit: stage marks
+    in-flight, release() retires the oldest."""
     staging = ingest.StagingBuffers(P, min_bucket=256)
     w, l = make_matches(100, seed=1)
     staging.stage(w, l)
@@ -246,10 +287,34 @@ def test_staging_double_buffers_and_stops_allocating():
     b = staging._rings[256][1]
     assert a is not b
     assert staging._next[256] == 0, "third stage must rotate back to slot 0"
+    assert staging.in_flight() == 2
+    staging.release()  # slot a's dispatch consumed
+    assert staging.in_flight() == 1
     for n in (1, 7, 100, 255):
         staging.stage(w[:n], l[:n])
+        staging.release()
     assert staging.slots_allocated == 2, "steady state allocated a new slot"
     assert staging.stages == 6
+
+
+def test_staging_rotation_into_in_flight_slot_raises():
+    """The in-flight guard: with both slots of a bucket staged and
+    neither released, a third stage must raise (silently overwriting
+    the arrays a live dispatch was staged from is the race the packer
+    thread would otherwise hit), and release() past empty raises too."""
+    staging = ingest.StagingBuffers(P, min_bucket=256)
+    w, l = make_matches(20, seed=6)
+    staging.stage(w, l)
+    staging.stage(w, l)
+    with pytest.raises(RuntimeError, match="in-flight"):
+        staging.stage(w, l)
+    # Releasing makes the same rotation legal again.
+    staging.release()
+    staging.stage(w, l)
+    staging.release()
+    staging.release()
+    with pytest.raises(RuntimeError, match="no in-flight"):
+        staging.release()
 
 
 def test_staged_pack_equals_pack_batch():
@@ -318,11 +383,13 @@ def test_mixed_update_and_ingest_share_one_history():
 
 
 def test_clone_is_independent():
-    csr = ingest.MergeableCSR(P, compact_threshold=64)
+    csr = ingest.MergeableCSR(P, compact_threshold=64, size_ratio=4)
     w, l = make_matches(50, seed=2)
     csr.add(w, l)
     snap = csr.clone()
     csr.add(w, l)
     assert snap.num_matches == 50 and csr.num_matches == 100
+    assert snap.size_ratio == csr.size_ratio
+    assert snap.compact_threshold == csr.compact_threshold
     perm, bounds = snap.grouping()
     assert perm.size == 100 and int(bounds[-1]) == 100

@@ -1,0 +1,394 @@
+"""Overlapped-ingest pipeline contracts (arena/pipeline.py + engine).
+
+The load-bearing property is PR 3's equivalence extended across a
+thread boundary: any stream of batches through `ingest_async` must
+land on EXACTLY the ratings the synchronous `ingest` path produces
+(same staged layout, same jitted function, same order — bit-exact),
+and both must equal a cold per-batch `update` replay. Around it, the
+lifecycle contracts the first concurrent subsystem needs pinned:
+
+- bounded-queue backpressure in BOTH policies (block waits and loses
+  nothing; drop-oldest sheds raw batches and counts them, and a
+  dropped batch never touches the match store);
+- shutdown mid-stream drains without loss (and the non-drain shutdown
+  still dispatches everything already merged, so store and ratings
+  can never disagree);
+- empty batches and compaction-boundary batches through the packer
+  thread;
+- a dead/never-started packer raises `PipelineError` instead of
+  hanging the caller;
+- zero steady-state jit compiles with the packer thread running
+  (thread-aware `RecompileSentinel`).
+
+The backpressure tests stall the packer deterministically by holding
+the match store's own lock (the same lock the packer merges under —
+no test seams in the pipeline).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from arena import engine, ingest, pipeline
+from arena.analysis import sanitize
+from arena.engine import ArenaEngine
+
+P = 40
+
+
+def make_matches(n, num_players=P, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, num_players, n).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, num_players - 1, n)) % num_players).astype(
+        np.int32
+    )
+    return a, b
+
+
+def random_split(w, l, seed, max_batches=8):
+    """Random contiguous split, always including one empty batch."""
+    rng = np.random.default_rng(seed)
+    cuts = np.sort(rng.integers(0, len(w) + 1, rng.integers(1, max_batches)))
+    bounds = [0, *cuts.tolist(), len(w)]
+    batches = [(w[a:b], l[a:b]) for a, b in zip(bounds, bounds[1:])]
+    batches.insert(int(rng.integers(0, len(batches) + 1)), (w[:0], l[:0]))
+    return batches
+
+
+def wait_until(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() > deadline:
+            pytest.fail(f"timed out waiting for {what}")
+        time.sleep(0.005)
+
+
+# --- the equivalence property (the satellite's named test) -----------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_async_matches_sync_bit_exact(seed):
+    """Property: ANY random split (empty batch included) streamed
+    through ingest_async == sync ingest BIT-EXACT == cold per-batch
+    update — and the chunked BT refit over the async history matches
+    the cold single-bucket fit. Also the fast kill for the
+    packer-thread-never-started mutant: with no packer, flush() raises
+    PipelineError instead of returning ratings."""
+    w, l = make_matches(900, seed=seed)
+    batches = random_split(w, l, seed=100 + seed)
+    eng_async, eng_sync, eng_cold = ArenaEngine(P), ArenaEngine(P), ArenaEngine(P)
+    for bw, bl in batches:
+        eng_async.ingest_async(bw, bl)
+    r_async = np.asarray(eng_async.flush())
+    for bw, bl in batches:
+        eng_sync.ingest(bw, bl)
+        eng_cold.update(bw, bl)
+    np.testing.assert_array_equal(r_async, np.asarray(eng_sync.ratings))
+    np.testing.assert_array_equal(r_async, np.asarray(eng_cold.ratings))
+    assert eng_async.matches_ingested == len(w)
+    chunked = np.asarray(eng_async.refit_incremental(num_iters=25, chunk_entries=512))
+    single = np.asarray(eng_cold.bt_strengths(num_iters=25))
+    np.testing.assert_allclose(chunked, single, atol=1e-3)
+    eng_async.shutdown()
+
+
+def test_compaction_boundary_batches_through_ingest_async():
+    """Batches sized to land ON and then cross the store's compaction
+    limit, with the galloping merge running on the PACKER thread; the
+    grouping stays exact and the ratings stay bit-exact to sync."""
+    w, l = make_matches(600, seed=7)
+    eng_async, eng_sync = ArenaEngine(P), ArenaEngine(P)
+    for eng in (eng_async, eng_sync):
+        eng._store.compact_threshold = 400  # floor (main is small here)
+    eng_async.ingest_async(w[:200], l[:200])  # tail lands exactly on 400
+    eng_async.ingest_async(w[200:201], l[200:201])  # 402 > 400: compacts
+    eng_async.ingest_async(w[201:], l[201:])
+    r_async = np.asarray(eng_async.flush())
+    assert eng_async._store.compactions >= 1
+    eng_sync.ingest(w[:200], l[:200])
+    eng_sync.ingest(w[200:201], l[200:201])
+    eng_sync.ingest(w[201:], l[201:])
+    np.testing.assert_array_equal(r_async, np.asarray(eng_sync.ratings))
+    # The merged grouping built under the packer's lock is exact.
+    perm, bounds = eng_async._store.grouping()
+    assert np.array_equal(np.sort(perm), np.arange(2 * 600))
+    assert int(bounds[-1]) == 2 * 600
+    eng_async.shutdown()
+
+
+def test_empty_batch_through_ingest_async_is_a_no_op():
+    eng = ArenaEngine(P)
+    before = np.asarray(eng.ratings).copy()
+    eng.ingest_async([], [])
+    np.testing.assert_array_equal(np.asarray(eng.flush()), before)
+    assert eng.matches_ingested == 0
+    assert eng._pipeline.pending() == 0
+    eng.shutdown()
+
+
+def test_ingest_async_rejects_bad_batch_at_the_call_site():
+    """Validation runs on the CALLING thread before anything is
+    queued: a malformed batch raises ValueError right there and no
+    engine or pipeline state changes."""
+    eng = ArenaEngine(8)
+    eng.ingest_async([0, 1], [2, 3])
+    eng.flush()
+    before = np.asarray(eng.ratings).copy()
+    with pytest.raises(ValueError, match="player ids"):
+        eng.ingest_async([0, 8], [1, 2])
+    np.testing.assert_array_equal(np.asarray(eng.flush()), before)
+    assert eng.matches_ingested == 2
+    assert eng._pipeline.submitted == 1  # the bad batch never enqueued
+    eng.shutdown()
+
+
+def test_sync_calls_drain_pending_async_work_first():
+    """Program order across the sync/async boundary: a sync ingest (or
+    update) issued after ingest_async must apply AFTER everything
+    already submitted."""
+    w, l = make_matches(300, seed=3)
+    eng_mixed, eng_sync = ArenaEngine(P), ArenaEngine(P)
+    eng_mixed.ingest_async(w[:100], l[:100])
+    eng_mixed.ingest(w[100:200], l[100:200])  # barrier + sync batch
+    eng_mixed.ingest_async(w[200:250], l[200:250])
+    eng_mixed.update(w[250:], l[250:])  # update() is a barrier too
+    r_mixed = np.asarray(eng_mixed.flush())
+    for a, b in ((0, 100), (100, 200), (200, 250)):
+        eng_sync.ingest(w[a:b], l[a:b])
+    eng_sync.update(w[250:], l[250:])
+    np.testing.assert_array_equal(r_mixed, np.asarray(eng_sync.ratings))
+    eng_mixed.shutdown()
+
+
+# --- backpressure ----------------------------------------------------------
+
+
+def stalled_packer(eng):
+    """Hold the match store's lock so the packer blocks at its first
+    store merge — the deterministic stall the backpressure tests need
+    (same lock the packer uses; no pipeline test seams)."""
+    return eng._store._lock
+
+
+def test_backpressure_block_policy_waits_and_loses_nothing():
+    w, l = make_matches(120, seed=4)
+    batches = [(w[i * 20 : (i + 1) * 20], l[i * 20 : (i + 1) * 20]) for i in range(6)]
+    eng = ArenaEngine(P)
+    pipe = eng.start_pipeline(capacity=2, policy="block")
+    lock = stalled_packer(eng)
+    submitted_all = threading.Event()
+
+    def producer():
+        for bw, bl in batches:
+            eng.ingest_async(bw, bl)
+        submitted_all.set()
+
+    with lock:  # packer stalls inside its first store merge
+        worker = threading.Thread(target=producer, daemon=True)
+        worker.start()
+        # The packer grabs batch 1, the queue holds 2 and 3; batch 4's
+        # submit must BLOCK (capacity 2), not drop and not proceed.
+        wait_until(lambda: pipe._packing, what="packer to pick up a batch")
+        wait_until(lambda: pipe.submitted == 3, what="queue to fill")
+        time.sleep(0.1)
+        assert not submitted_all.is_set(), "block policy failed to block"
+        assert pipe.dropped_batches == 0
+    worker.join(timeout=10.0)
+    assert submitted_all.is_set()
+    r_async = np.asarray(eng.flush())
+    assert pipe.dropped_batches == 0 and pipe.dropped_matches == 0
+    eng_sync = ArenaEngine(P)
+    for bw, bl in batches:
+        eng_sync.ingest(bw, bl)
+    np.testing.assert_array_equal(r_async, np.asarray(eng_sync.ratings))
+    eng.shutdown()
+
+
+def test_backpressure_drop_oldest_sheds_and_counts():
+    """drop-oldest: a full queue evicts the OLDEST raw batch. Dropped
+    batches never reached the match store, so the final ratings and
+    history equal a sync run over exactly the surviving batches."""
+    w, l = make_matches(100, seed=5)
+    batches = [(w[i * 20 : (i + 1) * 20], l[i * 20 : (i + 1) * 20]) for i in range(5)]
+    eng = ArenaEngine(P)
+    pipe = eng.start_pipeline(capacity=2, policy="drop-oldest")
+    lock = stalled_packer(eng)
+    with lock:
+        eng.ingest_async(*batches[0])  # packer picks this up, stalls
+        wait_until(lambda: pipe._packing, what="packer to pick up batch 0")
+        eng.ingest_async(*batches[1])  # queue: [1]
+        eng.ingest_async(*batches[2])  # queue: [1, 2]
+        eng.ingest_async(*batches[3])  # full -> drops 1, queue: [2, 3]
+        eng.ingest_async(*batches[4])  # full -> drops 2, queue: [3, 4]
+    r_async = np.asarray(eng.flush())
+    assert pipe.dropped_batches == 2
+    assert pipe.dropped_matches == 40
+    assert eng.matches_ingested == 60  # only batches 0, 3, 4 exist
+    eng_sync = ArenaEngine(P)
+    for i in (0, 3, 4):
+        eng_sync.ingest(*batches[i])
+    np.testing.assert_array_equal(r_async, np.asarray(eng_sync.ratings))
+    eng.shutdown()
+
+
+# --- shutdown / drain ------------------------------------------------------
+
+
+def test_shutdown_mid_stream_drains_without_loss():
+    w, l = make_matches(800, seed=8)
+    batches = random_split(w, l, seed=9)
+    eng = ArenaEngine(P)
+    for bw, bl in batches:
+        eng.ingest_async(bw, bl)
+    r_async = np.asarray(eng.shutdown(drain=True))  # no explicit flush first
+    assert eng._pipeline is None
+    assert eng.matches_ingested == len(w)
+    eng_sync = ArenaEngine(P)
+    for bw, bl in batches:
+        eng_sync.ingest(bw, bl)
+    np.testing.assert_array_equal(r_async, np.asarray(eng_sync.ratings))
+
+
+def test_non_drain_shutdown_drops_raw_but_keeps_merged_consistent():
+    """close(drain=False) drops batches still in the RAW queue, but a
+    batch the packer already merged into the store is ALWAYS
+    dispatched — the store and the ratings can never disagree."""
+    w, l = make_matches(80, seed=10)
+    batches = [(w[i * 20 : (i + 1) * 20], l[i * 20 : (i + 1) * 20]) for i in range(4)]
+    eng = ArenaEngine(P)
+    pipe = eng.start_pipeline(capacity=8)
+    lock = stalled_packer(eng)
+    closer = threading.Thread(target=lambda: eng.shutdown(drain=False), daemon=True)
+    with lock:
+        for bw, bl in batches:
+            eng.ingest_async(bw, bl)
+        wait_until(lambda: pipe._packing, what="packer to pick up batch 0")
+        closer.start()
+        wait_until(lambda: pipe.dropped_batches == 3, what="raw queue drop")
+    closer.join(timeout=10.0)
+    assert not closer.is_alive()
+    assert pipe.dropped_matches == 60
+    assert eng.matches_ingested == 20  # batch 0 was merged -> dispatched
+    eng_sync = ArenaEngine(P)
+    eng_sync.ingest(*batches[0])
+    np.testing.assert_array_equal(
+        np.asarray(eng.ratings), np.asarray(eng_sync.ratings)
+    )
+
+
+def test_submit_after_close_raises_and_engine_restarts_lazily():
+    eng = ArenaEngine(P)
+    w, l = make_matches(30, seed=12)
+    eng.ingest_async(w, l)
+    pipe = eng._pipeline
+    eng.shutdown()
+    with pytest.raises(pipeline.PipelineError, match="closed"):
+        pipe.submit(w, l)
+    # The engine starts a fresh pipeline transparently.
+    eng.ingest_async(w, l)
+    assert eng._pipeline is not pipe
+    eng.flush()
+    assert eng.matches_ingested == 60
+    eng.shutdown()
+
+
+def test_start_pipeline_twice_and_bad_config_raise():
+    eng = ArenaEngine(P)
+    eng.start_pipeline(capacity=2)
+    with pytest.raises(RuntimeError, match="already running"):
+        eng.start_pipeline()
+    eng.shutdown()
+    with pytest.raises(ValueError, match="policy"):
+        eng.start_pipeline(policy="newest-wins")
+    with pytest.raises(ValueError, match="capacity"):
+        eng.start_pipeline(capacity=0)
+
+
+def test_dead_packer_raises_instead_of_hanging(monkeypatch):
+    """Every blocking wait re-checks packer liveness: a packer that
+    never started (or died) surfaces as PipelineError at the next
+    flush, never as a hang."""
+    monkeypatch.setattr(pipeline.threading.Thread, "start", lambda self: None)
+    eng = ArenaEngine(P)
+    w, l = make_matches(10, seed=13)
+    eng.ingest_async(w, l)
+    with pytest.raises(pipeline.PipelineError, match="packer thread"):
+        eng.flush()
+
+
+def test_packer_error_surfaces_on_flush_and_drops_queue():
+    """An exception in the packer (not reachable through validated
+    engine input — forced here) is recorded, queued work is counted
+    dropped, and flush()/submit() re-raise it as PipelineError."""
+    eng = ArenaEngine(P)
+    pipe = eng.start_pipeline(capacity=8)
+    boom = RuntimeError("forced pack failure")
+
+    def exploding_pack(w, l):
+        raise boom
+
+    eng._pack_for_pipeline = exploding_pack
+    w, l = make_matches(10, seed=14)
+    eng.ingest_async(w, l)
+    with pytest.raises(pipeline.PipelineError, match="forced pack failure"):
+        eng.flush()
+    assert pipe.dropped_batches == 1
+    with pytest.raises(pipeline.PipelineError):
+        pipe.submit(w, l)
+    eng._pipeline = None  # the broken pipeline is unusable; detach
+
+
+# --- steady state ----------------------------------------------------------
+
+
+def test_steady_state_async_ingest_causes_zero_recompiles():
+    """The acceptance criterion with the packer thread running: after
+    warmup, arbitrary batch sizes through ingest_async add ZERO
+    jit-cache entries (thread-aware sentinel) and the staging pool
+    stays fixed."""
+    eng = ArenaEngine(P)
+    w, l = make_matches(engine.MIN_BUCKET, seed=15)
+    eng.ingest_async(w[:10], l[:10])
+    eng.ingest_async(w[:20], l[:20])
+    eng.flush()  # warmup: floor bucket compiled, both slots exist
+    sentinel = sanitize.RecompileSentinel(update=eng.num_compiles)
+    slots_after_warmup = eng._staging.slots_allocated
+    for n in (1, 7, 100, 255, engine.MIN_BUCKET):
+        eng.ingest_async(w[:n], l[:n])
+    eng.flush()
+    sentinel.assert_no_new_compiles()
+    assert eng._staging.slots_allocated == slots_after_warmup
+    assert eng._staging.in_flight() == 0, "drained pipeline left slots marked"
+    eng.shutdown()
+
+
+def test_recompile_sentinel_sees_compiles_from_other_threads():
+    """The thread-aware half: jit caches are process-global, so a
+    compile triggered on a worker thread moves a sentinel built on the
+    main thread."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x * 3.0)
+    f(jnp.zeros(3))
+    sentinel = sanitize.RecompileSentinel(f=f)
+    worker = threading.Thread(target=lambda: f(jnp.zeros(9)), daemon=True)
+    worker.start()
+    worker.join(timeout=30.0)
+    with pytest.raises(sanitize.RecompileError, match="f: 1 -> 2"):
+        sentinel.assert_no_new_compiles()
+
+
+def test_pipeline_counters_and_pending():
+    eng = ArenaEngine(P)
+    w, l = make_matches(100, seed=16)
+    pending_after = eng.ingest_async(w, l)
+    assert pending_after in (0, 1)  # may already have been dispatched
+    eng.flush()
+    pipe = eng._pipeline
+    assert pipe.pending() == 0
+    assert pipe.submitted == 1 and pipe.completed == 1
+    assert pipe.host_pack_s > 0 and pipe.dispatch_s >= 0
+    eng.shutdown()

@@ -54,15 +54,18 @@ def test_docs_cite_the_live_mutant_count():
 
 def test_mutations_cover_every_policed_surface():
     """bench + gate (the honesty machinery), jaxlint (the lint rules
-    whose corpus test is itself a policed property since PR 2), and the
-    incremental ingest layer (whose equivalence/threshold/peak-bucket
-    contracts are policed properties since PR 3)."""
+    whose corpus test is itself a policed property since PR 2), the
+    incremental ingest layer (equivalence/threshold/peak-bucket, PR 3),
+    and since PR 4 the overlapped pipeline (packer liveness) plus the
+    arena bench's async equivalence gate."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
         "verify_reference.py",
         "arena/analysis/jaxlint.py",
         "arena/ingest.py",
+        "arena/pipeline.py",
+        "arena/bench_arena.py",
     }
 
 
@@ -89,6 +92,8 @@ def _fake_sources_only(dest):
         "verify_reference.py",
         "arena/analysis/jaxlint.py",
         "arena/ingest.py",
+        "arena/pipeline.py",
+        "arena/bench_arena.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)
