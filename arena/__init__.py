@@ -16,6 +16,10 @@ Modules:
 - `arena.pipeline` — overlapped ingest: the background packing thread
   behind a bounded queue (`ArenaEngine.ingest_async`/`flush`), with
   block / drop-oldest backpressure and a lossless drain protocol.
+- `arena.serving`  — the serving surface: durable snapshot/restore of
+  the whole engine (versioned on-disk format, `SnapshotError` reject
+  posture), batched queries from immutable staleness-bounded views,
+  production-mode sanitizer counters.
 - `arena.sharding` — device mesh, partition-rule matching, shard_map
   data-parallel updates (CPU-mesh testable, no TPU required).
 - `arena.baseline` — the deliberately naive loop implementation the
@@ -27,30 +31,38 @@ from arena.engine import ArenaEngine, bucket_size, pack_batch, pack_epoch
 from arena.ingest import MergeableCSR, StagingBuffers, chunk_layout
 from arena.pipeline import IngestPipeline, PipelineError
 from arena.ratings import (
+    bootstrap_intervals,
     bt_fit,
     bt_fit_chunked,
     elo_batch_update,
     elo_batch_update_sorted,
+    elo_bootstrap,
     elo_epoch,
     elo_expected,
     sorted_segment_sum,
     sorted_segment_sum_chunked,
 )
+from arena.serving import ArenaServer, ServingView, SnapshotError
 
 __all__ = [
     "ArenaEngine",
+    "ArenaServer",
     "IngestPipeline",
     "MergeableCSR",
     "PipelineError",
+    "ServingView",
+    "SnapshotError",
     "StagingBuffers",
     "bucket_size",
     "chunk_layout",
     "pack_batch",
     "pack_epoch",
+    "bootstrap_intervals",
     "bt_fit",
     "bt_fit_chunked",
     "elo_batch_update",
     "elo_batch_update_sorted",
+    "elo_bootstrap",
     "elo_epoch",
     "elo_expected",
     "sorted_segment_sum",

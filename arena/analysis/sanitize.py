@@ -20,6 +20,13 @@ failure LOUD in tests instead of a silently wrong number in production:
   but semantically-wrong results — the guard turns that reuse into an
   immediate `RuntimeError: Array has been deleted`.
 
+Test posture raises; production posture counts: since the serving
+layer, `RecompileSentinel(mode="count")` and
+`donation_guard(mode="count", sample_every=N)` fold violations into
+counters (`ArenaServer.stats()` exposes them) instead of raising —
+a long-lived server wants the metric, not the crash. Defaults are
+unchanged: tests still get the loud failure.
+
 Everything here imports jax; the linter half of this package does not.
 Keep it that way — lint must run on boxes with no accelerator stack.
 """
@@ -102,11 +109,27 @@ class RecompileSentinel:
     quiescent point (after `ArenaEngine.flush()` has drained the
     pipeline), otherwise an in-flight compile may land on either side
     of the snapshot.
+
+    PRODUCTION (metrics) MODE since the serving layer:
+    `RecompileSentinel(mode="count", ...)` never raises — `observe()`
+    folds any cache growth into the `recompile_events` counter and
+    re-snapshots, so a long-lived server surfaces recompiles as a
+    metric (`ArenaServer.stats()`) instead of a crashed request.
+    `assert_no_new_compiles` delegates to `observe()` in count mode.
+    The default mode stays "raise": the test posture is unchanged.
     """
 
-    def __init__(self, **watched):
+    MODES = ("raise", "count")
+
+    def __init__(self, mode="raise", **watched):
+        if mode not in self.MODES:
+            raise ValueError(
+                f"unknown sentinel mode {mode!r}; pick one of {self.MODES}"
+            )
         if not watched:
             raise ValueError("nothing to watch")
+        self.mode = mode
+        self.recompile_events = 0
         self._watched = watched
         self._lock = threading.Lock()
         self.snapshot()
@@ -126,7 +149,29 @@ class RecompileSentinel:
                     out[name] = (before, now)
             return out
 
+    def observe(self) -> dict:
+        """Fold cache growth into `recompile_events` and re-baseline.
+
+        Atomic read-count-resnapshot, so concurrent observers never
+        double-count one compile. Returns the growth dict (empty when
+        nothing compiled) in every mode — this is the metrics-mode
+        read path, but raise-mode callers may use it for logging too.
+        """
+        with self._lock:
+            grew = {}
+            for name, obj in self._watched.items():
+                now = _cache_count(obj)
+                before = self._baseline[name]
+                if now != before:
+                    grew[name] = (before, now)
+                    self.recompile_events += now - before
+                    self._baseline[name] = now
+            return grew
+
     def assert_no_new_compiles(self):
+        if self.mode == "count":
+            self.observe()
+            return
         grew = self.new_compiles()
         if grew:
             detail = ", ".join(
@@ -149,26 +194,60 @@ class RecompileSentinel:
         return False
 
 
-def donation_guard(fn, donate_argnums=(0,)):
+def donation_guard(fn, donate_argnums=(0,), mode="raise", sample_every=1):
     """Wrap a donating callable so reuse-after-donate fails loudly.
 
-    After every call, each positional argument named in `donate_argnums`
-    that is a live `jax.Array` is explicitly deleted. If the wrapped
-    function's own donation already consumed the buffer (the healthy
-    case) this does nothing; if donation was silently skipped, the
-    buffer dies here instead of lingering as a stale alias — and any
-    later use raises `RuntimeError: Array has been deleted`.
+    mode="raise" (default, test posture): after every call, each
+    positional argument named in `donate_argnums` that is a live
+    `jax.Array` is explicitly deleted. If the wrapped function's own
+    donation already consumed the buffer (the healthy case) this does
+    nothing; if donation was silently skipped, the buffer dies here
+    instead of lingering as a stale alias — and any later use raises
+    `RuntimeError: Array has been deleted`.
+
+    mode="count" (production/serving posture): every `sample_every`-th
+    call, the guard only OBSERVES — a donated argument that survived
+    the call (XLA skipped donation with nothing but a warning) bumps
+    `guarded.donation_skipped` instead of being deleted, so a live
+    server keeps serving and the skip shows up in metrics
+    (`ArenaServer.stats()`), not as a mid-request crash. Sampling
+    keeps the is_deleted() probes off most of the hot path.
+    Counters on the wrapper: `calls`, `sampled`, `donation_skipped`.
+
+    The wrapper passes through the wrapped jit's `_cache_size` (when
+    present), so `ArenaEngine.num_compiles` and `RecompileSentinel`
+    keep working on a guarded update function.
     """
+    if mode not in ("raise", "count"):
+        raise ValueError(f"unknown donation_guard mode {mode!r}")
+    if sample_every < 1:
+        raise ValueError(f"sample_every must be >= 1, got {sample_every}")
 
     @functools.wraps(fn)
     def guarded(*args, **kwargs):
+        guarded.calls += 1
         out = fn(*args, **kwargs)
+        if mode == "count" and guarded.calls % sample_every:
+            return out
         for i in donate_argnums:
             if i >= len(args):
                 continue
             arg = args[i]
-            if isinstance(arg, jax.Array) and not arg.is_deleted():
+            if not isinstance(arg, jax.Array):
+                continue
+            if mode == "count":
+                if not arg.is_deleted():
+                    guarded.donation_skipped += 1
+            elif not arg.is_deleted():
                 arg.delete()
+        if mode == "count":
+            guarded.sampled += 1
         return out
 
+    guarded.calls = 0
+    guarded.sampled = 0
+    guarded.donation_skipped = 0
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is not None:
+        guarded._cache_size = cache_size
     return guarded

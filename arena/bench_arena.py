@@ -73,7 +73,22 @@ one CPU, so the overlap cannot beat sync wall-clock there — the number
 is reported as measured, not inflated (same honesty stance as the
 sharded path's per-device-count numbers).
 
-Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest | pipeline),
+A fourth mode, ``ARENA_BENCH_MODE=serve``, measures the SERVING layer
+(`arena/serving.py`): snapshot/restore round-trip timing on the
+streamed-up base — HARD-gated bit-exact (restored ratings, restored
+grouping, and a post-restore resumed stream must all match the live
+engine; any divergence emits the ``arena_bench_equivalence_failure``
+line and exits rc 2) — then query throughput (the headline ``value``,
+queries/s) from a reader thread while the main thread keeps ingesting.
+Every response is checked for VIEW TEARING: Elo conserves total rating
+mass, so a view mixing two rating vectors breaks conservation
+(``max_view_mass_dev``, gated by the same tolerance), pages must be
+sorted, watermarks monotone. A thread-aware `RecompileSentinel`
+asserts zero steady-state compiles across the serve and ingest
+threads; the production-mode sanitizer counters ride in the line.
+
+Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest | pipeline |
+serve),
 ARENA_BENCH_MATCHES (100000), ARENA_BENCH_PLAYERS (1000),
 ARENA_BENCH_BATCH (8192), ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED
 (0), ARENA_BENCH_BT_ITERS (25), ARENA_BENCH_TOL (0.5 rating points —
@@ -81,15 +96,18 @@ the equivalence gate), ARENA_BENCH_DELTA (10000, ingest mode; also the
 pipeline mode's streamed batch size), ARENA_BENCH_BT_TOL (0.01, ingest
 mode — chunked-vs-single BT gate), ARENA_BENCH_STREAM_BATCHES (8,
 pipeline mode — streamed batches per repeat), ARENA_BENCH_QUEUE_CAPACITY
-(8, pipeline mode), ARENA_BENCH_DEVICES (unset — forces a host CPU
-device count for the sharded path when the backend is not yet
-initialized).
+(8, pipeline mode), ARENA_BENCH_BOOTSTRAP_ROUNDS (8, serve mode),
+ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
+sharded path when the backend is not yet initialized).
 """
 
 import json
 import os
 import pathlib
+import shutil
 import sys
+import tempfile
+import threading
 import time
 
 # Must precede any JAX computation (backend init reads XLA_FLAGS; the
@@ -111,7 +129,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 import bench  # noqa: E402  (exc_detail — the repo-wide error formatting)
-from arena import baseline, engine, ingest, ratings, sharding  # noqa: E402
+from arena import baseline, engine, ingest, ratings, serving, sharding  # noqa: E402
 from arena.analysis import sanitize  # noqa: E402
 
 # Max |rating diff| tolerated between the naive float64 loop and the
@@ -590,12 +608,192 @@ def run_pipeline_benchmark():
     }
 
 
+def run_serve_benchmark():
+    """The serving-layer measurement: snapshot/restore round-trip on a
+    streamed-up base (HARD equivalence gate — the restored engine must
+    match the live one bit-exactly and must continue the stream to the
+    same ratings), then query throughput from a second thread while
+    the main thread keeps ingesting. Every query response is checked
+    for view tearing (Elo is zero-sum, so a view mixing two rating
+    vectors breaks conservation; pages must be sorted; watermarks must
+    be monotone) and a thread-aware RecompileSentinel asserts zero
+    steady-state compiles across BOTH threads."""
+    base_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
+    stream_batch = _env_int("ARENA_BENCH_DELTA", 10_000)
+    stream_batches = _env_int("ARENA_BENCH_STREAM_BATCHES", 8)
+    num_players = _env_int("ARENA_BENCH_PLAYERS", 1_000)
+    batch = _env_int("ARENA_BENCH_BATCH", 8_192)
+    repeats = _env_int("ARENA_BENCH_REPEATS", 5)
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+    bootstrap_rounds = _env_int("ARENA_BENCH_BOOTSTRAP_ROUNDS", 8)
+    tol = float(os.environ.get("ARENA_BENCH_TOL", EQUIVALENCE_TOL))
+
+    total = base_matches + stream_batch * (1 + stream_batches)
+    winners, losers = make_matches(total, num_players, seed)
+
+    srv = serving.ArenaServer(
+        num_players=num_players,
+        max_staleness_matches=stream_batch,
+        bootstrap_rounds=bootstrap_rounds,
+    )
+    for start, stop in _batch_slices(base_matches, batch):
+        srv.engine.ingest(winners[start:stop], losers[start:stop])
+
+    snap_root = pathlib.Path(tempfile.mkdtemp(prefix="arena-serve-bench-"))
+    try:
+        snap_dir = snap_root / "snap"
+        snapshot_s = _best_of(lambda: srv.snapshot(snap_dir), repeats)
+        manifest = json.loads((snap_dir / serving.MANIFEST_NAME).read_text())
+        restored = serving.ArenaServer(
+            num_players=num_players, max_staleness_matches=stream_batch
+        )
+        restore_s = _best_of(
+            lambda: (
+                restored.restore(snap_dir),
+                jax.block_until_ready(restored.engine.ratings),
+            ),
+            repeats,
+        )
+
+        # --- HARD gate 1: the round-trip is bit-exact (ratings AND the
+        # grouping — a dropped delta tail or re-sorted runs would show
+        # here as structural divergence) ------------------------------
+        r_live = np.asarray(srv.engine.ratings)
+        r_restored = np.asarray(restored.engine.ratings)
+        max_diff = float(np.abs(r_restored - r_live).max())
+        if not max_diff < tol:
+            raise EquivalenceError(max_diff, tol)
+        perm_live, bounds_live = srv.engine._store.clone().grouping()
+        perm_rest, bounds_rest = restored.engine._store.clone().grouping()
+        if not (
+            np.array_equal(perm_live, perm_rest)
+            and np.array_equal(bounds_live, bounds_rest)
+        ):
+            raise EquivalenceError(float("inf"), tol)
+
+        # --- HARD gate 2: the restored engine RESUMES the stream to
+        # the same ratings (warmup batch doubles as the stream-bucket
+        # compile, outside the steady-state window) -------------------
+        w0 = winners[base_matches : base_matches + stream_batch]
+        l0 = losers[base_matches : base_matches + stream_batch]
+        srv.engine.ingest(w0, l0)
+        restored.engine.ingest(w0, l0)
+        max_resume_diff = float(
+            np.abs(
+                np.asarray(restored.engine.ratings)
+                - np.asarray(srv.engine.ratings)
+            ).max()
+        )
+        if not max_resume_diff < tol:
+            raise EquivalenceError(max_resume_diff, tol)
+
+        # --- query throughput under concurrent ingest ----------------
+        # Warmup: intervals (their epoch compile), one query (first
+        # view), then the sentinel pins the steady state across both
+        # threads.
+        srv.refresh_intervals(batch_size=batch)
+        srv.query(leaderboard=(0, 10), players=[0], pairs=[(0, 1)])
+        sentinel = sanitize.RecompileSentinel(update=srv.engine.num_compiles)
+        base_mass = num_players * float(ratings.DEFAULT_BASE)
+        stop_event = threading.Event()
+        torn = []
+        counts = {"queries": 0}
+        max_mass_dev = [0.0]
+
+        def reader():
+            last_watermark = 0
+            ids = list(range(0, num_players, max(1, num_players // 8)))
+            while not stop_event.is_set():
+                resp = srv.query(
+                    leaderboard=(0, 10), players=ids, pairs=[(0, 1)]
+                )
+                counts["queries"] += 1
+                page = [row["rating"] for row in resp["leaderboard"]]
+                if page != sorted(page, reverse=True):
+                    torn.append("unsorted leaderboard page")
+                    return
+                view_ratings = resp["view_ratings_sum"]
+                dev = abs(view_ratings - base_mass) / num_players
+                max_mass_dev[0] = max(max_mass_dev[0], dev)
+                if resp["watermark"] < last_watermark:
+                    torn.append("watermark went backwards")
+                    return
+                last_watermark = resp["watermark"]
+
+        reader_thread = threading.Thread(target=reader, daemon=True)
+        offset = base_matches + stream_batch
+        t0 = time.perf_counter()
+        reader_thread.start()
+        for i in range(stream_batches):
+            start = offset + i * stream_batch
+            srv.engine.ingest(
+                winners[start : start + stream_batch],
+                losers[start : start + stream_batch],
+            )
+        jax.block_until_ready(srv.engine.ratings)
+        stream_s = time.perf_counter() - t0
+        stop_event.set()
+        reader_thread.join(timeout=60.0)
+        elapsed = time.perf_counter() - t0
+        sentinel.assert_no_new_compiles()
+        # --- HARD gate 3: no query observed a torn view. The mass
+        # deviation is in per-player rating points, gated by the same
+        # tolerance as the rating diffs.
+        if torn:
+            raise EquivalenceError(float("inf"), tol)
+        if not max_mass_dev[0] < tol:
+            raise EquivalenceError(max_mass_dev[0], tol)
+        qps = counts["queries"] / elapsed
+        stats = srv.stats()
+    finally:
+        shutil.rmtree(snap_root, ignore_errors=True)
+
+    streamed = stream_batch * stream_batches
+    return {
+        "metric": "arena_serve",
+        "value": round(qps, 2),
+        "unit": "queries_per_s",
+        "vs_baseline": None,
+        "params": {
+            "base_matches": base_matches,
+            "stream_batch": stream_batch,
+            "stream_batches": stream_batches,
+            "num_players": num_players,
+            "batch_size": batch,
+            "repeats": repeats,
+            "seed": seed,
+            "bootstrap_rounds": bootstrap_rounds,
+            "max_staleness_matches": stream_batch,
+            "host_cores": os.cpu_count() or 1,
+        },
+        "serve": {
+            "snapshot_s": round(snapshot_s, 6),
+            "restore_s": round(restore_s, 6),
+            "snapshot_mb": round(manifest["bin_bytes"] / 1e6, 3),
+            "snapshot_matches": manifest["num_matches"],
+            "queries_under_ingest": counts["queries"],
+            "ingest_stream_s": round(stream_s, 6),
+            "stream_matches_per_s": round(streamed / stream_s),
+            "view_refreshes": stats["view_refreshes"],
+            "stale_serves": stats["stale_serves"],
+            "max_view_mass_dev": round(max_mass_dev[0], 6),
+            "steady_state_new_compiles": 0,  # sentinel raised otherwise
+            "recompile_events_counted": stats["recompile_events"],
+            "donation_skipped": stats["donation_skipped"],
+        },
+        "equivalence_ok": True,
+        "max_rating_diff": round(max_diff, 6),
+        "max_resume_diff": round(max_resume_diff, 6),
+    }
+
+
 def main() -> int:
     rc = 0
     mode = os.environ.get("ARENA_BENCH_MODE", "elo")
     runners = {
         "ingest": (run_ingest_benchmark, "x_vs_cold_repack"),
         "pipeline": (run_pipeline_benchmark, "x_vs_sync_ingest"),
+        "serve": (run_serve_benchmark, "queries_per_s"),
     }
     runner, unit = runners.get(mode, (run_benchmark, "x_vs_naive_baseline"))
     try:

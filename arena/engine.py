@@ -36,6 +36,7 @@ The host-side half of the hot path. Three jobs:
    order is preserved no matter how the two are mixed.
 """
 
+import threading
 from functools import partial
 from typing import NamedTuple
 
@@ -192,6 +193,7 @@ class ArenaEngine:
         self.num_players = num_players
         self.k = k
         self.scale = scale
+        self.base = base
         self.min_bucket = min_bucket
         self._dtype = dtype
         self.ratings = jnp.full((num_players,), base, dtype)
@@ -207,6 +209,15 @@ class ArenaEngine:
         self._store = ingest_mod.MergeableCSR(num_players)
         self._staging = None  # built on first ingest()
         self._pipeline = None  # built on first ingest_async()
+        # Matches whose rating update has been DISPATCHED — the serving
+        # watermark. Lags matches_ingested by whatever the async
+        # pipeline still holds. The lock makes (ratings, watermark)
+        # an atomic pair: the serving layer copies both under it, so a
+        # view can never mix one batch's ratings with another's count —
+        # and, because the update DONATES the old ratings buffer, the
+        # copy must not race the dispatch that consumes it.
+        self._view_lock = threading.Lock()
+        self.matches_applied = 0
         self._update = jax.jit(
             partial(R.elo_batch_update_sorted, k=k, scale=scale),
             donate_argnums=(0,),
@@ -217,14 +228,48 @@ class ArenaEngine:
         return self._store.num_matches
 
     def _apply(self, packed):
-        self.ratings = self._update(
-            self.ratings,
-            packed.winners,
-            packed.losers,
-            packed.valid.astype(self._dtype),
-            packed.perm,
-            packed.bounds,
-        )
+        with self._view_lock:
+            self.ratings = self._update(
+                self.ratings,
+                packed.winners,
+                packed.losers,
+                packed.valid.astype(self._dtype),
+                packed.perm,
+                packed.bounds,
+            )
+            self.matches_applied += packed.num_real
+        return self.ratings
+
+    def ratings_snapshot(self):
+        """Atomic `(ratings copy, applied-match watermark)` pair — the
+        raw material of a serving view. The copy is explicit
+        (`np.array(copy=True)`): `np.asarray` of a CPU jax array can
+        alias the device buffer, and the very next `_apply` DONATES
+        that buffer — an aliased view would be read-after-donate."""
+        with self._view_lock:
+            return np.array(self.ratings, copy=True), self.matches_applied
+
+    def adopt_state(self, ratings, store):
+        """Install restored state (the serving layer's snapshot hook):
+        ratings vector + match store, replacing the fresh-engine
+        empties. Refuses on an engine that has already ingested —
+        restore-into-live must go through `ArenaServer.restore`, which
+        builds a fresh engine and swaps it in whole."""
+        if self._store.num_matches or self.matches_applied:
+            raise RuntimeError(
+                "adopt_state requires a fresh engine; this one has "
+                f"{self._store.num_matches} matches ingested"
+            )
+        r = np.asarray(ratings, np.float32)
+        if store.num_players != self.num_players or r.shape != (self.num_players,):
+            raise ValueError(
+                f"restored state is for {store.num_players} players / "
+                f"ratings shape {r.shape}; engine has {self.num_players}"
+            )
+        with self._view_lock:
+            self.ratings = jnp.asarray(r)
+            self._store = store
+            self.matches_applied = store.num_matches
         return self.ratings
 
     def update(self, winners, losers):
@@ -336,17 +381,23 @@ class ArenaEngine:
         jax.block_until_ready(self.ratings)
         return self.ratings
 
-    def shutdown(self, drain=True):
+    def shutdown(self, drain=True, spill=False):
         """Stop the pipeline thread. drain=True (default) applies
         everything still queued; drain=False drops raw batches (see
-        `IngestPipeline.close`). Safe to call with no pipeline; after
-        shutdown, `ingest_async` starts a fresh pipeline lazily."""
+        `IngestPipeline.close`). spill=True instead RETURNS the
+        still-raw queued batches as `(winners, losers)` pairs (FIFO,
+        not counted dropped) for a durable snapshot to persist — the
+        caller owns resubmitting them. Safe to call with no pipeline;
+        after shutdown, `ingest_async` starts a fresh pipeline lazily.
+        Returns the ratings normally, the spilled batch list when
+        spill=True."""
+        spilled = []
         if self._pipeline is not None:
             try:
-                self._pipeline.close(drain=drain)
+                spilled = self._pipeline.close(drain=drain, spill=spill)
             finally:
                 self._pipeline = None
-        return self.ratings
+        return spilled if spill else self.ratings
 
     def refit_incremental(self, num_iters=50, prior=0.1, chunk_entries=None):
         """Chunked Bradley–Terry refit over the incremental grouping.
@@ -379,6 +430,45 @@ class ArenaEngine:
             jnp.asarray(chunk_bounds),
             win_counts,
         )
+
+    def bootstrap_ratings(self, num_rounds=32, seed=0, batch_size=8192):
+        """Bootstrap rating samples: `num_rounds` Poisson-resampled
+        epochs over the full ingested history, vmapped over a seeded
+        key array (`ratings.elo_bootstrap`). Each round replays the
+        whole match set from the base rating with per-match Poisson(1)
+        weights — the weight multiplies the same `valid` mask the
+        padded slots use, so resampling rides the precomputed grouping
+        with zero re-sorts. Deterministic under a fixed seed. Returns
+        a (num_rounds, num_players) ndarray of rating samples; the
+        serving layer turns them into (lo, hi) intervals.
+
+        Epoch batch boundaries here are `batch_size` re-splits of the
+        history, not the original ingest boundaries — the bootstrap
+        measures resampling uncertainty, not a bit-exact replay (the
+        crash-restart property owns that)."""
+        self._drain_pipeline()
+        if self._store.num_matches == 0:
+            raise ValueError("no matches ingested")
+        if num_rounds < 1:
+            raise ValueError(f"num_rounds must be >= 1, got {num_rounds}")
+        packed = pack_epoch(
+            self.num_players,
+            self._store.winners(),
+            self._store.losers(),
+            batch_size,
+        )
+        keys = jax.random.split(jax.random.PRNGKey(seed), num_rounds)
+        fn = R.jit_elo_bootstrap(k=self.k, scale=self.scale)
+        samples = fn(
+            jnp.full((self.num_players,), self.base, self._dtype),
+            packed.winners,
+            packed.losers,
+            packed.valid,
+            packed.perms,
+            packed.bounds,
+            keys,
+        )
+        return np.asarray(samples)
 
     def num_compiles(self):
         """Jit-cache size of the update fn — the recompile budget the

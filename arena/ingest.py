@@ -265,6 +265,98 @@ class MergeableCSR:
             ).astype(np.int32)
             return self._pos, bounds
 
+    def export_state(self):
+        """Run-level state for a durable snapshot: independent copies of
+        the main sorted runs, the delta tail AS RUNS (per-run lengths
+        preserved so a restore re-splits them without re-sorting — the
+        point of the mergeable structure is that the O(N log N) sort
+        never happens again, and that includes across a process
+        restart), and the raw match log. Taken under the same lock the
+        packer merges under, so a snapshot during concurrent ingest is
+        a consistent structure. Every array is int32; the serving
+        layer writes them raw."""
+        with self._lock:
+            tail_lengths = np.array(
+                [run.size for run in self._tail_keys], np.int32
+            )
+            return {
+                "num_matches": self.num_matches,
+                "compactions": self.compactions,
+                "compact_threshold": self.compact_threshold,
+                "size_ratio": self.size_ratio,
+                "keys": self._keys.copy(),
+                "pos": self._pos.copy(),
+                "tail_keys": (
+                    np.concatenate(self._tail_keys)
+                    if self._tail_keys
+                    else np.empty(0, np.int32)
+                ),
+                "tail_pos": (
+                    np.concatenate(self._tail_pos)
+                    if self._tail_pos
+                    else np.empty(0, np.int32)
+                ),
+                "tail_run_lengths": tail_lengths,
+                "winners": self._w[: self.num_matches].copy(),
+                "losers": self._l[: self.num_matches].copy(),
+            }
+
+    @classmethod
+    def from_state(cls, num_players, state):
+        """Rebuild a store from `export_state` output WITHOUT re-sorting:
+        the main runs and each tail run are installed as-is (they were
+        sorted when exported; restore trusts the arrays only after the
+        cross-checks below). Raises ValueError on any internal
+        inconsistency — the serving loader converts that into its
+        distinct SnapshotError, with the store never half-built."""
+        csr = cls(
+            num_players,
+            compact_threshold=int(state["compact_threshold"]),
+            size_ratio=int(state["size_ratio"]),
+        )
+        n = int(state["num_matches"])
+        keys = np.asarray(state["keys"], np.int32)
+        pos = np.asarray(state["pos"], np.int32)
+        tail_keys = np.asarray(state["tail_keys"], np.int32)
+        tail_pos = np.asarray(state["tail_pos"], np.int32)
+        run_lengths = np.asarray(state["tail_run_lengths"], np.int64)
+        w = np.asarray(state["winners"], np.int32)
+        l = np.asarray(state["losers"], np.int32)
+        if w.size != n or l.size != n:
+            raise ValueError(
+                f"match log length {w.size}/{l.size} != num_matches {n}"
+            )
+        if keys.size != pos.size or tail_keys.size != tail_pos.size:
+            raise ValueError("grouping keys/pos arrays disagree in length")
+        if int(run_lengths.sum()) != tail_keys.size:
+            raise ValueError(
+                f"tail run lengths sum to {int(run_lengths.sum())}, "
+                f"tail holds {tail_keys.size} entries"
+            )
+        if keys.size + tail_keys.size != 2 * n:
+            raise ValueError(
+                f"grouping covers {keys.size + tail_keys.size} entries, "
+                f"expected {2 * n} (2 per match)"
+            )
+        _validate_matches(num_players, w, l)
+        if keys.size and (keys[:-1] > keys[1:]).any():
+            raise ValueError("main run keys are not sorted")
+        csr.num_matches = n
+        csr.compactions = int(state["compactions"])
+        csr._keys = keys
+        csr._pos = pos
+        if run_lengths.size:
+            splits = np.cumsum(run_lengths)[:-1]
+            csr._tail_keys = list(np.split(tail_keys, splits))
+            csr._tail_pos = list(np.split(tail_pos, splits))
+        csr._tail_entries = tail_keys.size
+        cap = max(1024, n)
+        csr._w = np.empty(cap, np.int32)
+        csr._l = np.empty(cap, np.int32)
+        csr._w[:n] = w
+        csr._l[:n] = l
+        return csr
+
     def clone(self):
         """Independent copy (bench baseline-vs-delta runs; also the
         seed of the snapshot/restore the serving layer will need).

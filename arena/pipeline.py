@@ -34,9 +34,12 @@ thread boundary the double buffer was built for:
   been packed and dispatched. `close(drain=True)` (the default)
   flushes, then stops and joins the packer; `close(drain=False)` drops
   the raw queue first (counted), still dispatches everything already
-  past the store merge, then joins. Every blocking wait re-checks
-  packer liveness, so a dead or never-started packer thread raises
-  `PipelineError` instead of hanging the caller.
+  past the store merge, then joins; `close(spill=True)` extracts the
+  raw queue instead of dropping it and returns the batches (validated
+  int32 array pairs, FIFO order) so a durable snapshot can persist
+  them — the serving layer's restart-mid-stream path. Every blocking
+  wait re-checks packer liveness, so a dead or never-started packer
+  thread raises `PipelineError` instead of hanging the caller.
 
 On this image's single host core the two threads share one CPU, so the
 overlap cannot beat the synchronous path in wall clock (the bench
@@ -98,6 +101,8 @@ class IngestPipeline:
         self.completed = 0
         self.dropped_batches = 0
         self.dropped_matches = 0
+        self.spilled_batches = 0
+        self.spilled_matches = 0
         # Host-pack vs device-dispatch breakdown (the bench reports it).
         self.host_pack_s = 0.0
         self.dispatch_s = 0.0
@@ -114,7 +119,12 @@ class IngestPipeline:
             return self._pending_locked()
 
     def _pending_locked(self):
-        return self.submitted - self.completed - self.dropped_batches
+        return (
+            self.submitted
+            - self.completed
+            - self.dropped_batches
+            - self.spilled_batches
+        )
 
     def _raise_if_failed_locked(self):
         if self._error is not None:
@@ -200,7 +210,7 @@ class IngestPipeline:
                 self._check_packer_locked()
                 self._cv.wait(_WAIT_S)
 
-    def close(self, drain=True):
+    def close(self, drain=True, spill=False):
         """Stop the pipeline and join the packer thread.
 
         drain=True processes everything still queued (lossless
@@ -209,10 +219,27 @@ class IngestPipeline:
         merged into the match store are always dispatched, so the
         store and the ratings can never disagree about which matches
         happened.
+
+        spill=True (implies drain=False for the raw queue) EXTRACTS
+        the still-raw batches instead of dropping them and returns
+        them, FIFO order preserved, as a list of validated
+        `(winners, losers)` int32 array pairs — exactly what a durable
+        snapshot needs to persist so a restarted server can resubmit
+        them and resume mid-stream (see `arena/serving.py`). Spilled
+        batches are NOT counted as dropped: they left this process's
+        queue but not the logical stream. Returns [] when not
+        spilling.
         """
+        spilled = []
         with self._cv:
             self._closed = True
-            if not drain:
+            if spill:
+                while self._raw:
+                    sw, sl = self._raw.popleft()
+                    self.spilled_batches += 1
+                    self.spilled_matches += int(sw.shape[0])
+                    spilled.append((sw, sl))
+            elif not drain:
                 while self._raw:
                     dw, _dl = self._raw.popleft()
                     self.dropped_batches += 1
@@ -224,6 +251,7 @@ class IngestPipeline:
             with self._cv:
                 self._cv.notify_all()
             self._thread.join(timeout=10.0)
+        return spilled
 
     # --- the packer thread -------------------------------------------
 

@@ -299,6 +299,65 @@ def jit_bt_fit(num_players, num_iters=50, prior=0.1):
     )
 
 
+# --- bootstrap confidence intervals ----------------------------------------
+#
+# LMSYS-style rating uncertainty: resample the match set with
+# replacement, replay the epoch, read the spread of the resampled
+# ratings. The resample is a POISSON bootstrap — each match gets an
+# independent Poisson(1) weight, equivalent in distribution to
+# multinomial resampling for large N but expressible as a pure
+# per-match multiply: the weight rides the SAME `valid` mask the
+# padded slots already use, so every bootstrap round reuses the
+# precomputed grouping (perm/bounds) with zero re-sorts and zero new
+# layouts. N rounds vmap over a seeded key array into one executable;
+# at the measured ~2ms per 100k-match epoch, 32 rounds are ~64ms of
+# device time per interval refresh.
+
+
+def elo_bootstrap(
+    ratings0, winners, losers, valid, perms, bounds, keys,
+    k=DEFAULT_K, scale=DEFAULT_SCALE,
+):
+    """Bootstrap rating samples: one resampled epoch per key.
+
+    All epoch arguments are the stacked per-batch layout `elo_epoch`
+    takes; `keys` is a (num_rounds, 2) jax PRNG key array (e.g.
+    `jax.random.split(jax.random.PRNGKey(seed), num_rounds)`).
+    Returns (num_rounds, num_players) ratings — deterministic for a
+    fixed key array. Pure function; wrap in jit at the call site
+    (`jit_elo_bootstrap`) or each round dispatches eagerly.
+    """
+
+    def one_round(key):
+        weights = jax.random.poisson(key, 1.0, shape=valid.shape).astype(
+            valid.dtype
+        )
+        return elo_epoch(
+            ratings0, winners, losers, valid * weights, perms, bounds, k, scale
+        )
+
+    return jax.vmap(one_round)(keys)
+
+
+def bootstrap_intervals(samples, alpha=0.05):
+    """(lo, hi) percentile interval per player from bootstrap samples.
+
+    `samples` is (num_rounds, num_players); returns two (num_players,)
+    arrays at the alpha/2 and 1-alpha/2 quantiles (central 1-alpha
+    interval, the standard percentile bootstrap).
+    """
+    lo = jnp.quantile(samples, alpha / 2.0, axis=0)
+    hi = jnp.quantile(samples, 1.0 - alpha / 2.0, axis=0)
+    return lo, hi
+
+
+def jit_elo_bootstrap(k=DEFAULT_K, scale=DEFAULT_SCALE):
+    """`elo_bootstrap` compiled for fixed constants. One executable per
+    (num_batches, batch, num_rounds) shape triple — refresh intervals
+    at a fixed cadence/shape to keep the cache flat."""
+    return jax.jit(partial(elo_bootstrap, k=k, scale=scale))
+
+
 def jit_elo_epoch(num_players, k=DEFAULT_K, scale=DEFAULT_SCALE, donate=True):
     """`elo_epoch` compiled with the ratings buffer donated.
 

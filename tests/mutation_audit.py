@@ -311,6 +311,45 @@ MUTATIONS = (
         "gate at tol 0) — the pattern deliberately covers the full block",
     ),
     (
+        "serving-restore-drops-the-delta-tail",
+        "arena/ingest.py",
+        "        if run_lengths.size:\n"
+        "            splits = np.cumsum(run_lengths)[:-1]\n"
+        "            csr._tail_keys = list(np.split(tail_keys, splits))\n"
+        "            csr._tail_pos = list(np.split(tail_pos, splits))\n"
+        "        csr._tail_entries = tail_keys.size",
+        "        csr._tail_entries = 0",
+        "a restored store must carry the delta tail's grouping runs; "
+        "dropping them is a SILENT partial restore (ratings and match log "
+        "look intact, every un-compacted entry's grouping is gone) — killed "
+        "by test_crash_restart_replay_is_bit_exact (restored tail_entries "
+        "> 0 and grouping covers every interleaved entry)",
+    ),
+    (
+        "serving-staleness-watermark-never-refreshed",
+        "arena/serving.py",
+        "        if view is None or self._staleness(view) > self.max_staleness_matches:\n"
+        "            view = self.refresh_view()",
+        "        if view is None:\n"
+        "            view = self.refresh_view()",
+        "the staleness policy must refresh a view once the stream moves past "
+        "max_staleness_matches; frozen at its first watermark the server "
+        "silently serves arbitrarily stale ratings forever — killed by "
+        "test_view_watermark_advances_past_staleness_bound",
+    ),
+    (
+        "serving-snapshot-version-check-skipped",
+        "arena/serving.py",
+        '    found_version = manifest.get("version")\n'
+        "    if found_version != SNAPSHOT_VERSION:",
+        '    found_version = manifest.get("version")\n'
+        "    if False:",
+        "the snapshot loader must reject a version it does not speak with "
+        "the distinct SnapshotError naming expected vs found, never "
+        "restore a format it cannot be sure it parses correctly — killed by "
+        "test_restore_rejects_mismatched_manifest_version",
+    ),
+    (
         "lint-donation-poisoning-dropped",
         "arena/analysis/jaxlint.py",
         "                            if target_name:\n"

@@ -75,14 +75,14 @@ def test_host_sync_rule_names_each_call_form():
 
 
 def test_default_targets_cover_the_ingest_and_pipeline_modules():
-    """The seven rules gate every NEW hot path: arena/ingest.py and
-    arena/pipeline.py must be inside the default-target walk (so
-    `python -m arena.analysis` and the clean-tree test both lint them)
-    and must themselves lint clean."""
+    """The seven rules gate every NEW hot path: arena/ingest.py,
+    arena/pipeline.py and arena/serving.py must be inside the
+    default-target walk (so `python -m arena.analysis` and the
+    clean-tree test both lint them) and must themselves lint clean."""
     walked = {
         str(f) for f in jaxlint.iter_python_files(jaxlint.default_targets())
     }
-    for mod in ("ingest.py", "pipeline.py"):
+    for mod in ("ingest.py", "pipeline.py", "serving.py"):
         path = str(REPO / "arena" / mod)
         assert path in walked, f"default targets no longer cover arena/{mod}"
         findings = jaxlint.lint_paths([path])

@@ -167,3 +167,89 @@ def test_checked_engine_epoch_is_nan_free():
     with sanitize.checked():
         feed(eng, 64, seed=7)
     assert np.isfinite(np.asarray(eng.ratings)).all()
+
+
+# --- production (metrics) mode (PR 5 satellite) ----------------------------
+
+
+def test_recompile_sentinel_count_mode_counts_instead_of_raising():
+    """Serving posture: cache growth lands in `recompile_events`,
+    assert_no_new_compiles never raises, and observe() re-baselines so
+    one compile is never double-counted."""
+    f = jax.jit(lambda x: x * 4.0)
+    f(jnp.zeros(3))
+    sentinel = sanitize.RecompileSentinel(mode="count", unbucketed=f)
+    f(jnp.zeros(5))  # new shape -> new compile
+    sentinel.assert_no_new_compiles()  # must NOT raise
+    assert sentinel.recompile_events == 1
+    assert sentinel.observe() == {}  # already folded in
+    assert sentinel.recompile_events == 1
+    f(jnp.zeros(7))
+    assert sentinel.observe() == {"unbucketed": (2, 3)}
+    assert sentinel.recompile_events == 2
+
+
+def test_recompile_sentinel_raise_mode_unchanged_and_modes_validated():
+    """The test posture is untouched by the metrics mode: the default
+    still raises, and an unknown mode is rejected."""
+    f = jax.jit(lambda x: x - 1.0)
+    f(jnp.zeros(2))
+    sentinel = sanitize.RecompileSentinel(f=f)
+    assert sentinel.mode == "raise"
+    f(jnp.zeros(9))
+    with pytest.raises(sanitize.RecompileError):
+        sentinel.assert_no_new_compiles()
+    with pytest.raises(ValueError, match="mode"):
+        sanitize.RecompileSentinel(mode="log", f=f)
+
+
+def test_donation_guard_count_mode_counts_skip_without_deleting():
+    """Production posture: a silently-skipped donation (donate=False
+    stands in for XLA skipping with a warning) is COUNTED, the stale
+    buffer survives, and the server keeps serving."""
+    num_players = 8
+    packed = engine.pack_epoch(num_players, [1, 2, 3], [4, 5, 6], batch_size=256)
+    args = (packed.winners, packed.losers, packed.valid, packed.perms,
+            packed.bounds)
+    guarded = sanitize.donation_guard(
+        R.jit_elo_epoch(num_players, donate=False), mode="count"
+    )
+    r = jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32)
+    guarded(r, *args)
+    assert guarded.donation_skipped == 1 and guarded.sampled == 1
+    # Deliberate: count mode must LEAVE the stale alias alive (observe,
+    # never mutate) — the exact read raise-mode forbids.
+    assert not r.is_deleted()  # jaxlint: disable=use-after-donate
+    # Healthy donation counts nothing.
+    healthy = sanitize.donation_guard(
+        R.jit_elo_epoch(num_players, donate=True), mode="count"
+    )
+    healthy(jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32), *args)
+    assert healthy.donation_skipped == 0 and healthy.sampled == 1
+
+
+def test_donation_guard_count_mode_samples_every_nth_call():
+    num_players = 8
+    packed = engine.pack_epoch(num_players, [1, 2], [4, 5], batch_size=256)
+    args = (packed.winners, packed.losers, packed.valid, packed.perms,
+            packed.bounds)
+    guarded = sanitize.donation_guard(
+        R.jit_elo_epoch(num_players, donate=False), mode="count", sample_every=3
+    )
+    for _ in range(9):
+        guarded(jnp.full((num_players,), R.DEFAULT_BASE, jnp.float32), *args)
+    assert guarded.calls == 9
+    assert guarded.sampled == 3  # calls 3, 6, 9
+    assert guarded.donation_skipped == 3
+
+
+def test_donation_guard_passes_through_cache_size_and_validates():
+    jitted = jax.jit(lambda x: x + 2.0)
+    jitted(jnp.zeros(4))
+    guarded = sanitize.donation_guard(jitted, mode="count")
+    assert guarded._cache_size() == 1  # RecompileSentinel keeps working
+    sanitize.RecompileSentinel(update=guarded).assert_no_new_compiles()
+    with pytest.raises(ValueError, match="mode"):
+        sanitize.donation_guard(jitted, mode="metrics")
+    with pytest.raises(ValueError, match="sample_every"):
+        sanitize.donation_guard(jitted, mode="count", sample_every=0)

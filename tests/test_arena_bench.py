@@ -223,6 +223,77 @@ def test_pipeline_bench_full_size_streams_clean():
     assert result["value"] > 0.5
 
 
+SERVE_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "serve",
+    "ARENA_BENCH_MATCHES": "20000",
+    "ARENA_BENCH_DELTA": "2000",
+    "ARENA_BENCH_STREAM_BATCHES": "4",
+    "ARENA_BENCH_PLAYERS": "64",
+    "ARENA_BENCH_BATCH": "2048",
+    "ARENA_BENCH_REPEATS": "2",
+    "ARENA_BENCH_BOOTSTRAP_ROUNDS": "4",
+}
+
+
+def test_serve_bench_smoke_contract():
+    """ARENA_BENCH_MODE=serve through the real entrypoint: one JSON
+    line, rc 0, the arena_serve metric with a BIT-EXACT snapshot/
+    restore round-trip (max_rating_diff and max_resume_diff both 0.0 —
+    ratings reload raw, the grouping reloads without re-sorting), a
+    positive query throughput under concurrent ingest, no torn views
+    (mass deviation inside the gate), zero steady-state compiles
+    across serve + ingest threads, and the production-mode sanitizer
+    counters in the line."""
+    result = run_bench(SERVE_SMOKE_ENV)
+    assert result["metric"] == "arena_serve"
+    assert result["unit"] == "queries_per_s"
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["max_resume_diff"] == 0.0
+    assert result["value"] > 0
+    assert result["serve"]["queries_under_ingest"] > 0
+    assert result["serve"]["snapshot_s"] > 0
+    assert result["serve"]["restore_s"] > 0
+    assert result["serve"]["snapshot_matches"] == 20000
+    assert result["serve"]["steady_state_new_compiles"] == 0
+    assert result["serve"]["max_view_mass_dev"] < 0.5
+    assert result["serve"]["donation_skipped"] == 0
+    assert result["params"]["max_staleness_matches"] == 2000
+
+
+def test_serve_bench_equivalence_gate_is_hard():
+    """The hard gate covers the serve path: with the tolerance forced
+    to 0 even the bit-exact round-trip trips it (no diff is < 0) —
+    the distinct equivalence-failure line (serve-mode unit, no
+    throughput fields) and rc 2, so a silently skipped gate is loudly
+    visible."""
+    result = run_bench(
+        {**SERVE_SMOKE_ENV, "ARENA_BENCH_TOL": "0"}, expect_rc=2
+    )
+    assert result["metric"] == "arena_bench_equivalence_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "queries_per_s"
+    assert result["tolerance"] == 0.0
+    assert "exceeds tolerance" in result["error"]
+    assert "serve" not in result and "bt" not in result
+
+
+@pytest.mark.slow
+def test_serve_bench_full_size_round_trips_100k_bit_exact():
+    """The acceptance criterion at the acceptance size: the 100k-match
+    base round-trips bit-exact, queries never observe a torn view, and
+    the steady state stays compile-free with both threads running."""
+    result = run_bench({"ARENA_BENCH_MODE": "serve"}, timeout=600)
+    assert result["metric"] == "arena_serve"
+    assert result["params"]["base_matches"] == 100_000
+    assert result["serve"]["snapshot_matches"] == 100_000
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["max_resume_diff"] == 0.0
+    assert result["serve"]["steady_state_new_compiles"] == 0
+    assert result["value"] > 0
+
+
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     """The hard gate: with the tolerance forced to 0 the (real, tiny)
     float32-vs-float64 divergence trips it — one JSON line carrying the

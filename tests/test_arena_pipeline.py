@@ -392,3 +392,56 @@ def test_pipeline_counters_and_pending():
     assert pipe.submitted == 1 and pipe.completed == 1
     assert pipe.host_pack_s > 0 and pipe.dispatch_s >= 0
     eng.shutdown()
+
+
+# --- queue spill (PR 5: the serving snapshot's resume-mid-stream seed) -----
+
+
+def test_close_spill_returns_raw_queue_fifo_without_dropping():
+    """close(spill=True) extracts the still-raw queue (FIFO, counted
+    spilled not dropped) while batches already merged are dispatched —
+    exactly the split a durable snapshot persists: store+ratings agree,
+    the spilled remainder resumes on restore."""
+    w, l = make_matches(100, seed=21)
+    batches = [(w[i * 20 : (i + 1) * 20], l[i * 20 : (i + 1) * 20]) for i in range(5)]
+    eng = ArenaEngine(P)
+    pipe = eng.start_pipeline(capacity=8)
+    lock = stalled_packer(eng)
+    result = {}
+
+    def closer():
+        result["spilled"] = eng.shutdown(spill=True)
+
+    with lock:
+        for bw, bl in batches:
+            eng.ingest_async(bw, bl)
+        wait_until(lambda: pipe._packing, what="packer to pick up batch 0")
+        worker = threading.Thread(target=closer, daemon=True)
+        worker.start()
+        wait_until(lambda: not pipe._raw, what="raw queue spill")
+    worker.join(timeout=10.0)
+    spilled = result["spilled"]
+    assert pipe.spilled_batches == 4 and pipe.spilled_matches == 80
+    assert pipe.dropped_batches == 0 and pipe.dropped_matches == 0
+    assert eng.matches_ingested == 20  # batch 0 merged -> dispatched
+    assert [tuple(sw.tolist()) for sw, _sl in spilled] == [
+        tuple(bw.tolist()) for bw, _bl in batches[1:]
+    ]
+    # Resubmitting the spill reproduces the uninterrupted stream.
+    for sw, sl in spilled:
+        eng.ingest(sw, sl)
+    eng_sync = ArenaEngine(P)
+    for bw, bl in batches:
+        eng_sync.ingest(bw, bl)
+    np.testing.assert_array_equal(
+        np.asarray(eng.ratings), np.asarray(eng_sync.ratings)
+    )
+
+
+def test_close_spill_with_empty_queue_returns_nothing():
+    eng = ArenaEngine(P)
+    w, l = make_matches(30, seed=22)
+    eng.ingest_async(w, l)
+    eng.flush()
+    assert eng.shutdown(spill=True) == []
+    assert eng.matches_ingested == 30

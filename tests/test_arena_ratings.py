@@ -195,3 +195,65 @@ def test_elo_expected_is_the_classic_formula():
         got = float(R.elo_expected(jnp.float32(rw), jnp.float32(rl)))
         want = baseline.elo_expected_naive(rw, rl)
         assert got == pytest.approx(want, abs=1e-5)
+
+
+# --- bootstrap confidence intervals (PR 5 satellite) -----------------------
+
+
+def test_elo_bootstrap_is_deterministic_under_a_fixed_seed():
+    w, l = make_matches(800, seed=6)
+    packed = engine.pack_epoch(N_PLAYERS, w, l, batch_size=256)
+    args = (packed.winners, packed.losers, packed.valid, packed.perms,
+            packed.bounds)
+    r0 = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(42), 6)
+    fn = R.jit_elo_bootstrap()
+    a = np.asarray(fn(r0, *args, keys))
+    b = np.asarray(fn(r0, *args, keys))
+    assert a.shape == (6, N_PLAYERS)
+    np.testing.assert_array_equal(a, b)
+    other = np.asarray(fn(r0, *args, jax.random.split(jax.random.PRNGKey(43), 6)))
+    assert not np.array_equal(a, other)
+
+
+def test_elo_bootstrap_round_is_a_poisson_weighted_epoch():
+    """Pin the resample semantics: each vmapped round is EXACTLY the
+    plain epoch with that key's Poisson(1) weights folded into the
+    valid mask — the padded-slot mask and the resample weights ride
+    the same multiply."""
+    w, l = make_matches(400, seed=7)
+    packed = engine.pack_epoch(N_PLAYERS, w, l, batch_size=256)
+    r0 = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    samples = np.asarray(
+        R.elo_bootstrap(
+            r0, packed.winners, packed.losers, packed.valid, packed.perms,
+            packed.bounds, keys,
+        )
+    )
+    for i in range(3):
+        weights = jax.random.poisson(keys[i], 1.0, shape=packed.valid.shape)
+        manual = R.elo_epoch(
+            r0, packed.winners, packed.losers,
+            packed.valid * weights.astype(packed.valid.dtype),
+            packed.perms, packed.bounds,
+        )
+        np.testing.assert_array_equal(samples[i], np.asarray(manual))
+
+
+def test_bootstrap_intervals_are_ordered_and_bracket_the_estimate():
+    w, l = make_matches(1200, seed=8)
+    packed = engine.pack_epoch(N_PLAYERS, w, l, batch_size=256)
+    args = (packed.winners, packed.losers, packed.valid, packed.perms,
+            packed.bounds)
+    r0 = jnp.full((N_PLAYERS,), R.DEFAULT_BASE, jnp.float32)
+    keys = jax.random.split(jax.random.PRNGKey(0), 16)
+    samples = R.jit_elo_bootstrap()(r0, *args, keys)
+    lo, hi = R.bootstrap_intervals(samples, alpha=0.05)
+    lo, hi = np.asarray(lo), np.asarray(hi)
+    assert (lo <= hi).all()
+    # Real spread for active players, and the percentile interval
+    # brackets the per-player sample median by construction.
+    med = np.median(np.asarray(samples), axis=0)
+    assert ((lo <= med) & (med <= hi)).all()
+    assert (hi - lo).max() > 1.0

@@ -1,0 +1,556 @@
+"""Serving-layer contracts (arena/serving.py).
+
+The load-bearing property is CRASH-RESTART EQUIVALENCE: ingest K
+batches, snapshot at a random boundary, throw the engine away, restore,
+replay the remainder — the ratings must be BIT-EXACT equal to the
+uninterrupted stream, and the restored grouping must cover every entry
+(the delta tail survives the round-trip; restore never re-sorts).
+Around it, the contracts a serving surface needs pinned:
+
+- the snapshot REJECT posture: wrong magic/version, truncated or
+  corrupt bytes, inconsistent counts → a distinct `SnapshotError`
+  naming expected vs found, with the live engine untouched (never a
+  silent partial restore);
+- a snapshot taken with a non-empty pipeline queue spills the raw
+  batches and a restore resubmits them in order (resume mid-stream);
+- staleness-bounded reads: the view watermark advances past the
+  `max_staleness_matches` bound (the mutation audit carries a mutant
+  that freezes it) and reads during an in-progress restore serve the
+  last complete view with `stale=True`;
+- the batched query API answers every part of one call from ONE view;
+- bootstrap (rating, lo, hi) intervals are deterministic under a fixed
+  seed;
+- production-mode sanitizers count instead of raising (`stats()`).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from arena import serving
+from arena.engine import ArenaEngine
+from arena.serving import ArenaServer, SnapshotError
+
+P = 40
+
+
+def make_matches(n, num_players=P, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, num_players, n).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, num_players - 1, n)) % num_players).astype(
+        np.int32
+    )
+    return a, b
+
+
+def random_split(w, l, seed, max_batches=10):
+    rng = np.random.default_rng(seed)
+    cuts = np.sort(rng.integers(0, len(w) + 1, rng.integers(2, max_batches)))
+    bounds = [0, *cuts.tolist(), len(w)]
+    return [(w[a:b], l[a:b]) for a, b in zip(bounds, bounds[1:])]
+
+
+def wait_until(predicate, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() > deadline:
+            pytest.fail(f"timed out waiting for {what}")
+        time.sleep(0.005)
+
+
+def assert_grouping_exact(store, num_matches):
+    """The restored grouping covers every interleaved entry exactly
+    once — the property a dropped delta tail breaks."""
+    perm, bounds = store.clone().grouping()
+    assert np.array_equal(np.sort(perm), np.arange(2 * num_matches))
+    assert int(bounds[-1]) == 2 * num_matches
+
+
+# --- crash-restart equivalence (the satellite's named property) ------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_crash_restart_replay_is_bit_exact(tmp_path, seed):
+    """Ingest K batches, snapshot at a random boundary, DISCARD the
+    engine, restore from disk, replay the remainder: ratings bit-exact
+    to the uninterrupted stream, grouping complete (the snapshot here
+    always carries a NON-EMPTY delta tail — batches are far below the
+    compaction floor, so nothing has compacted), and the chunked BT
+    refit over the restored store matches the uninterrupted one."""
+    w, l = make_matches(1000, seed=seed)
+    batches = random_split(w, l, seed=50 + seed)
+    cut = int(np.random.default_rng(90 + seed).integers(1, len(batches)))
+
+    uninterrupted = ArenaEngine(P)
+    for bw, bl in batches:
+        uninterrupted.ingest(bw, bl)
+
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    for bw, bl in batches[:cut]:
+        srv.engine.ingest(bw, bl)
+    assert srv.engine._store.tail_entries > 0  # the tail rides the snapshot
+    srv.snapshot(tmp_path / "snap")
+    del srv  # the "crash": nothing survives but the on-disk snapshot
+
+    restored = ArenaServer(num_players=P)
+    restored.restore(tmp_path / "snap")
+    assert restored.engine._store.tail_entries > 0
+    for bw, bl in batches[cut:]:
+        restored.engine.ingest(bw, bl)
+
+    np.testing.assert_array_equal(
+        np.asarray(restored.engine.ratings), np.asarray(uninterrupted.ratings)
+    )
+    assert restored.engine.matches_ingested == len(w)
+    assert_grouping_exact(restored.engine._store, len(w))
+    np.testing.assert_allclose(
+        np.asarray(restored.engine.refit_incremental(num_iters=20)),
+        np.asarray(uninterrupted.refit_incremental(num_iters=20)),
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_crash_restart_with_nonempty_pipeline_queue(tmp_path, seed):
+    """The spill form: snapshot taken while the async pipeline still
+    holds raw batches. The queue rides the snapshot (validated batches
+    are just int32 arrays), restore resubmits them FIFO, and the
+    restored ratings equal the uninterrupted stream bit-exact."""
+    w, l = make_matches(600, seed=seed)
+    step = 100
+    batches = [
+        (w[i * step : (i + 1) * step], l[i * step : (i + 1) * step])
+        for i in range(6)
+    ]
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    eng = srv.engine
+    eng.ingest(*batches[0])
+    pipe = eng.start_pipeline(capacity=8)
+    result = {}
+
+    def snap():
+        try:
+            result["manifest"] = srv.snapshot(tmp_path / "snap", spill=True)
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            result["error"] = exc
+
+    with eng._store._lock:  # stall the packer inside its first merge
+        for bw, bl in batches[1:]:
+            eng.ingest_async(bw, bl)
+        wait_until(lambda: pipe._packing, what="packer to pick up a batch")
+        worker = threading.Thread(target=snap, daemon=True)
+        worker.start()
+        wait_until(lambda: not pipe._raw, what="queue spill")
+    worker.join(timeout=30.0)
+    assert "error" not in result, result.get("error")
+    manifest = result["manifest"]
+    # Batch 1 was mid-pack (always merged + dispatched, never spilled);
+    # batches 2..5 were still raw and rode the snapshot.
+    assert manifest["queue_batches"] == 4
+    assert manifest["queue_matches"] == 4 * step
+    assert manifest["num_matches"] == 2 * step
+
+    restored = ArenaServer(num_players=P)
+    restored.restore(tmp_path / "snap")
+    uninterrupted = ArenaEngine(P)
+    for bw, bl in batches:
+        uninterrupted.ingest(bw, bl)
+    np.testing.assert_array_equal(
+        np.asarray(restored.engine.ratings), np.asarray(uninterrupted.ratings)
+    )
+    assert restored.engine.matches_ingested == len(w)
+    assert_grouping_exact(restored.engine._store, len(w))
+
+
+def test_snapshot_after_compaction_restores_runs_without_resort(tmp_path):
+    """Main runs AND a fresh tail both survive: force a compaction
+    mid-stream, keep ingesting, snapshot, restore — run/tail split
+    preserved exactly (restore installs the arrays, it never
+    re-sorts or re-compacts)."""
+    w, l = make_matches(800, seed=9)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w[:500], l[:500])
+    srv.engine._store.compact()
+    srv.engine.ingest(w[500:], l[500:])
+    store = srv.engine._store
+    assert store._keys.size == 1000 and store.tail_entries == 600
+    compactions = store.compactions
+    srv.snapshot(tmp_path / "snap")
+
+    restored = ArenaServer(num_players=P)
+    restored.restore(tmp_path / "snap")
+    rstore = restored.engine._store
+    assert rstore._keys.size == 1000 and rstore.tail_entries == 600
+    assert rstore.compactions == compactions
+    np.testing.assert_array_equal(rstore._keys, store._keys)
+    np.testing.assert_array_equal(rstore._pos, store._pos)
+    assert_grouping_exact(rstore, 800)
+
+
+# --- the snapshot reject posture -------------------------------------------
+
+
+def build_server_with_snapshot(tmp_path, n=300, seed=4):
+    w, l = make_matches(n, seed=seed)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w, l)
+    srv.snapshot(tmp_path / "snap")
+    return srv, tmp_path / "snap"
+
+
+def assert_reject_leaves_engine_untouched(srv, snap, match):
+    before = np.asarray(srv.engine.ratings).copy()
+    matches_before = srv.engine.matches_ingested
+    store_before = srv.engine._store
+    with pytest.raises(SnapshotError, match=match):
+        srv.restore(snap)
+    assert srv.engine.matches_ingested == matches_before
+    assert srv.engine._store is store_before
+    np.testing.assert_array_equal(np.asarray(srv.engine.ratings), before)
+    assert srv._restoring is False  # the marker is cleared on reject
+
+
+def test_restore_rejects_mismatched_manifest_version(tmp_path):
+    """The version gate names expected vs found and the live engine is
+    untouched — the mutation audit carries the check-skipped mutant;
+    this is its named kill."""
+    srv, snap = build_server_with_snapshot(tmp_path)
+    man = snap / serving.MANIFEST_NAME
+    doc = json.loads(man.read_text())
+    doc["version"] = 99
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(
+        srv, snap, match=r"expected 1, found 99"
+    )
+
+
+def test_restore_rejects_corrupt_binary_header(tmp_path):
+    srv, snap = build_server_with_snapshot(tmp_path)
+    blob = bytearray((snap / serving.ARRAYS_NAME).read_bytes())
+    blob[8:12] = (7).to_bytes(4, "little")  # header version field
+    (snap / serving.ARRAYS_NAME).write_bytes(bytes(blob))
+    assert_reject_leaves_engine_untouched(
+        srv, snap, match=r"header version: expected 1, found 7"
+    )
+    # A payload byte flip past the header is caught by the checksum.
+    blob = bytearray((snap / serving.ARRAYS_NAME).read_bytes())
+    blob[8:12] = (1).to_bytes(4, "little")
+    blob[-1] ^= 0xFF
+    (snap / serving.ARRAYS_NAME).write_bytes(bytes(blob))
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"checksum mismatch")
+
+
+def test_restore_rejects_truncated_arrays(tmp_path):
+    srv, snap = build_server_with_snapshot(tmp_path)
+    blob = (snap / serving.ARRAYS_NAME).read_bytes()
+    (snap / serving.ARRAYS_NAME).write_bytes(blob[: len(blob) // 2])
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"truncated")
+
+
+def test_restore_rejects_wrong_magic_and_missing_pieces(tmp_path):
+    srv, snap = build_server_with_snapshot(tmp_path)
+    man = snap / serving.MANIFEST_NAME
+    doc = json.loads(man.read_text())
+    doc["magic"] = "NOTARENA"
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"bad snapshot magic")
+    man.unlink()
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"no snapshot manifest")
+
+
+def test_restore_rejects_inconsistent_counts(tmp_path):
+    """Manifest counts disagreeing with the arrays (num_matches edited
+    after the fact) is a distinct reject, not a partial restore."""
+    srv, snap = build_server_with_snapshot(tmp_path)
+    man = snap / serving.MANIFEST_NAME
+    doc = json.loads(man.read_text())
+    doc["num_matches"] = doc["num_matches"] + 7
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"match log holds")
+
+
+def test_restore_rejects_malformed_manifest_fields(tmp_path):
+    """Wrong-TYPED manifest fields are a SnapshotError too — never a
+    raw TypeError/KeyError leaking out of the loader."""
+    srv, snap = build_server_with_snapshot(tmp_path)
+    man = snap / serving.MANIFEST_NAME
+    pristine = man.read_text()
+    doc = json.loads(pristine)
+    doc["num_matches"] = "three-hundred"
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"non-negative int")
+    doc = json.loads(pristine)
+    doc["k"] = None
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"must be numeric")
+    doc = json.loads(pristine)
+    del doc["arrays"][0]["offset"]
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv, snap, match=r"malformed snapshot")
+
+
+def test_snapshot_binary_format_is_versioned_and_checksummed(tmp_path):
+    _srv, snap = build_server_with_snapshot(tmp_path)
+    blob = (snap / serving.ARRAYS_NAME).read_bytes()
+    assert blob[:8] == serving.SNAPSHOT_MAGIC
+    assert int.from_bytes(blob[8:12], "little") == serving.SNAPSHOT_VERSION
+    doc = json.loads((snap / serving.MANIFEST_NAME).read_text())
+    assert doc["magic"] == "ARENASNP" and doc["version"] == 1
+    assert doc["bin_bytes"] == len(blob)
+    names = {entry["name"] for entry in doc["arrays"]}
+    assert {"keys", "pos", "tail_keys", "winners", "losers", "ratings"} <= names
+    # int32 arrays written raw: the winners entry slices back to the log.
+    entry = next(e for e in doc["arrays"] if e["name"] == "winners")
+    assert entry["dtype"] == "int32"
+    winners = np.frombuffer(
+        blob, np.int32, count=entry["length"], offset=entry["offset"]
+    )
+    assert winners.size == doc["num_matches"]
+
+
+def test_adopt_state_refuses_nonfresh_engine():
+    w, l = make_matches(50, seed=11)
+    eng = ArenaEngine(P)
+    eng.ingest(w, l)
+    donor = ArenaEngine(P)
+    with pytest.raises(RuntimeError, match="fresh engine"):
+        eng.adopt_state(np.zeros(P, np.float32), donor._store)
+
+
+# --- staleness-bounded reads -----------------------------------------------
+
+
+def test_view_watermark_advances_past_staleness_bound():
+    """The staleness policy refreshes the view once the ingested
+    stream moves more than max_staleness_matches past its watermark —
+    the mutation audit carries a never-refreshed mutant; this is its
+    named kill."""
+    w, l = make_matches(400, seed=12)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w[:100], l[:100])
+    first = srv.query(leaderboard=(0, 3))
+    assert first["watermark"] == 100 and first["stale"] is False
+    srv.engine.ingest(w[100:], l[100:])
+    second = srv.query(leaderboard=(0, 3))
+    assert second["watermark"] == 400, "stale view served past the bound"
+    assert second["staleness"] == 0 and second["stale"] is False
+    assert second["view_seq"] > first["view_seq"]
+
+
+def test_wide_staleness_bound_keeps_serving_the_old_view():
+    w, l = make_matches(300, seed=13)
+    srv = ArenaServer(num_players=P, max_staleness_matches=1000)
+    srv.engine.ingest(w[:200], l[:200])
+    first = srv.query(players=[0])
+    srv.engine.ingest(w[200:], l[200:])
+    second = srv.query(players=[0])
+    # Within the bound: same view, honestly reported staleness.
+    assert second["view_seq"] == first["view_seq"]
+    assert second["watermark"] == first["watermark"]
+    assert second["staleness"] == 100 and second["stale"] is False
+
+
+def test_reads_during_restore_serve_last_view_with_stale_marker(
+    tmp_path, monkeypatch
+):
+    srv, snap = build_server_with_snapshot(tmp_path)
+    warm = srv.query(leaderboard=(0, 3))
+    in_read = threading.Event()
+    release = threading.Event()
+    real_read = serving.read_snapshot
+
+    def slow_read(path):
+        in_read.set()
+        assert release.wait(timeout=30.0)
+        return real_read(path)
+
+    monkeypatch.setattr(serving, "read_snapshot", slow_read)
+    worker = threading.Thread(target=lambda: srv.restore(snap), daemon=True)
+    worker.start()
+    wait_until(in_read.is_set, what="restore to reach the snapshot read")
+    during = srv.query(leaderboard=(0, 3))
+    assert during["stale"] is True
+    assert during["view_seq"] == warm["view_seq"]  # the last COMPLETE view
+    release.set()
+    worker.join(timeout=30.0)
+    after = srv.query(leaderboard=(0, 3))
+    assert after["stale"] is False
+    assert after["view_seq"] > warm["view_seq"]
+    assert srv.stats()["stale_serves"] >= 1
+
+
+# --- the batched query API -------------------------------------------------
+
+
+def test_query_batched_parts_come_from_one_view():
+    w, l = make_matches(500, seed=14)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w, l)
+    resp = srv.query(leaderboard=(0, 10), players=[0, 5, 7], pairs=[(0, 1), (1, 0)])
+    assert resp["watermark"] == 500
+    board = resp["leaderboard"]
+    assert [row["rank"] for row in board] == list(range(1, 11))
+    ratings = [row["rating"] for row in board]
+    assert ratings == sorted(ratings, reverse=True)
+    by_id = {row["player"]: row for row in resp["players"]}
+    assert set(by_id) == {0, 5, 7}
+    r = np.asarray(srv.engine.ratings)
+    for p, row in by_id.items():
+        assert row["rating"] == pytest.approx(float(r[p]))
+        assert row["wins"] == int((w == p).sum())
+        assert row["losses"] == int((l == p).sum())
+    pab, pba = resp["pairs"]
+    assert 0.0 < pab["p_a_beats_b"] < 1.0
+    assert pab["p_a_beats_b"] + pba["p_a_beats_b"] == pytest.approx(1.0)
+
+
+def test_query_pagination_and_validation():
+    w, l = make_matches(100, seed=15)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w, l)
+    full = srv.query(leaderboard=(0, P))["leaderboard"]
+    page = srv.query(leaderboard=(5, 5))["leaderboard"]
+    assert [r["player"] for r in page] == [r["player"] for r in full[5:10]]
+    past_end = srv.query(leaderboard=(P + 3, 5))["leaderboard"]
+    assert past_end == []
+    with pytest.raises(ValueError, match="player ids"):
+        srv.query(players=[P])
+    with pytest.raises(ValueError, match="pair"):
+        srv.query(pairs=[(0, P)])
+    with pytest.raises(ValueError, match="non-negative"):
+        srv.query(leaderboard=(-1, 5))
+
+
+def test_query_under_concurrent_ingest_is_never_torn():
+    """Tier-1 version of the serve bench's torn-view check: a query
+    thread hammers the server while the main thread ingests. Every
+    response must be internally consistent — ratings from ONE rating
+    vector (Elo is zero-sum, so the view's total rating mass is
+    conserved), watermark monotone, pages sorted."""
+    w, l = make_matches(4000, seed=16)
+    srv = ArenaServer(num_players=P, max_staleness_matches=100)
+    srv.engine.ingest(w[:500], l[:500])
+    stop = threading.Event()
+    failures = []
+    seen = {"last_watermark": 0, "queries": 0}
+    base_mass = P * 1500.0
+
+    def reader():
+        while not stop.is_set():
+            resp = srv.query(leaderboard=(0, P))
+            seen["queries"] += 1
+            board = resp["leaderboard"]
+            ratings = [row["rating"] for row in board]
+            if ratings != sorted(ratings, reverse=True):
+                failures.append("unsorted page")
+            if abs(sum(ratings) - base_mass) > 1.0:
+                failures.append(f"zero-sum broken: {sum(ratings)}")
+            if resp["watermark"] < seen["last_watermark"]:
+                failures.append("watermark went backwards")
+            seen["last_watermark"] = resp["watermark"]
+
+    worker = threading.Thread(target=reader, daemon=True)
+    worker.start()
+    for start in range(500, 4000, 250):
+        srv.engine.ingest(w[start : start + 250], l[start : start + 250])
+    stop.set()
+    worker.join(timeout=30.0)
+    assert not failures, failures[:5]
+    assert seen["queries"] > 0
+    final = srv.query(leaderboard=(0, 1))
+    assert final["watermark"] == 4000
+
+
+# --- bootstrap confidence intervals ----------------------------------------
+
+
+def test_query_returns_rating_lo_hi_deterministic_under_seed():
+    w, l = make_matches(600, seed=17)
+
+    def build():
+        srv = ArenaServer(
+            num_players=P, max_staleness_matches=0,
+            bootstrap_rounds=8, bootstrap_seed=123,
+        )
+        srv.engine.ingest(w, l)
+        srv.refresh_intervals(batch_size=256)
+        return srv
+
+    a, b = build(), build()
+    ra = a.query(players=list(range(P)))["players"]
+    rb = b.query(players=list(range(P)))["players"]
+    for row_a, row_b in zip(ra, rb):
+        assert row_a["lo"] == row_b["lo"] and row_a["hi"] == row_b["hi"]
+        assert row_a["lo"] <= row_a["hi"]
+    # Intervals are real spread, not degenerate points.
+    assert any(row["hi"] - row["lo"] > 1.0 for row in ra)
+    # A different seed moves the resample.
+    c = ArenaServer(
+        num_players=P, max_staleness_matches=0,
+        bootstrap_rounds=8, bootstrap_seed=7,
+    )
+    c.engine.ingest(w, l)
+    c.refresh_intervals(batch_size=256)
+    rc = c.query(players=list(range(P)))["players"]
+    assert any(
+        row_c["lo"] != row_a["lo"] for row_c, row_a in zip(rc, ra)
+    )
+
+
+def test_intervals_absent_until_refreshed():
+    w, l = make_matches(100, seed=18)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w, l)
+    row = srv.query(players=[0])["players"][0]
+    assert row["lo"] is None and row["hi"] is None
+
+
+# --- production-mode sanitizers via stats() --------------------------------
+
+
+def test_stats_counters_and_count_mode_sanitizers():
+    """The serving path runs the sanitizers in metrics mode by
+    default: warmup compiles land in recompile_events (never a raise),
+    the donation guard samples the donating update, and the serving
+    counters move."""
+    w, l = make_matches(300, seed=19)
+    srv = ArenaServer(
+        num_players=P, max_staleness_matches=0, donation_sample_every=1
+    )
+    for start in range(0, 300, 50):
+        srv.engine.ingest(w[start : start + 50], l[start : start + 50])
+    srv.query(leaderboard=(0, 5))
+    stats = srv.stats()
+    assert stats["queries"] == 1
+    assert stats["view_refreshes"] >= 1
+    assert stats["matches_ingested"] == stats["matches_applied"] == 300
+    # The engine's one warmup compile was COUNTED, not raised.
+    assert stats["recompile_events"] >= 1
+    assert stats["donation_calls"] == 6
+    assert stats["donation_sampled"] == 6
+    # CPU honors donate_argnums, so no skip events on this backend.
+    assert stats["donation_skipped"] == 0
+    before = stats["recompile_events"]
+    srv.engine.ingest(w[:50], l[:50])  # same bucket: no new compile
+    assert srv.stats()["recompile_events"] == before
+
+
+def test_server_constructor_validation():
+    with pytest.raises(ValueError, match="exactly one"):
+        ArenaServer()
+    with pytest.raises(ValueError, match="exactly one"):
+        ArenaServer(num_players=P, engine=ArenaEngine(P))
+    with pytest.raises(ValueError, match="max_staleness_matches"):
+        ArenaServer(num_players=P, max_staleness_matches=-1)
+
+
+def test_restore_server_cold_start(tmp_path):
+    srv, snap = build_server_with_snapshot(tmp_path)
+    cold = serving.restore_server(snap, max_staleness_matches=0)
+    np.testing.assert_array_equal(
+        np.asarray(cold.engine.ratings), np.asarray(srv.engine.ratings)
+    )
+    assert cold.query(leaderboard=(0, 3))["watermark"] == 300

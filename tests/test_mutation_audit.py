@@ -56,8 +56,10 @@ def test_mutations_cover_every_policed_surface():
     """bench + gate (the honesty machinery), jaxlint (the lint rules
     whose corpus test is itself a policed property since PR 2), the
     incremental ingest layer (equivalence/threshold/peak-bucket, PR 3),
-    and since PR 4 the overlapped pipeline (packer liveness) plus the
-    arena bench's async equivalence gate."""
+    since PR 4 the overlapped pipeline (packer liveness) plus the
+    arena bench's async equivalence gate, and since PR 5 the serving
+    layer (silent-partial-restore, staleness policy, snapshot version
+    gate)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -65,6 +67,7 @@ def test_mutations_cover_every_policed_surface():
         "arena/analysis/jaxlint.py",
         "arena/ingest.py",
         "arena/pipeline.py",
+        "arena/serving.py",
         "arena/bench_arena.py",
     }
 
@@ -93,6 +96,7 @@ def _fake_sources_only(dest):
         "arena/analysis/jaxlint.py",
         "arena/ingest.py",
         "arena/pipeline.py",
+        "arena/serving.py",
         "arena/bench_arena.py",
     ):
         target = dest / name
