@@ -20,6 +20,12 @@ Modules:
   the whole engine (versioned on-disk format, `SnapshotError` reject
   posture), batched queries from immutable staleness-bounded views,
   production-mode sanitizer counters.
+- `arena.obs`     — zero-dependency observability: thread-safe metrics
+  registry (counters/gauges/log2 histograms, Prometheus `render()`,
+  one-JSON-line `dump()`, `NullRegistry` no-op twin) and span tracing
+  into a bounded ring with Chrome trace-event export. Every subsystem
+  above reports through it; `ArenaEngine` defaults to the no-op
+  instance, `ArenaServer` to a live one.
 - `arena.sharding` — device mesh, partition-rule matching, shard_map
   data-parallel updates (CPU-mesh testable, no TPU required).
 - `arena.baseline` — the deliberately naive loop implementation the
@@ -29,6 +35,7 @@ Modules:
 
 from arena.engine import ArenaEngine, bucket_size, pack_batch, pack_epoch
 from arena.ingest import MergeableCSR, StagingBuffers, chunk_layout
+from arena.obs import NullRegistry, Observability, Registry, Tracer
 from arena.pipeline import IngestPipeline, PipelineError
 from arena.ratings import (
     bootstrap_intervals,
@@ -49,7 +56,11 @@ __all__ = [
     "ArenaServer",
     "IngestPipeline",
     "MergeableCSR",
+    "NullRegistry",
+    "Observability",
     "PipelineError",
+    "Registry",
+    "Tracer",
     "ServingView",
     "SnapshotError",
     "StagingBuffers",

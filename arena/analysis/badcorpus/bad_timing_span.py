@@ -1,0 +1,27 @@
+"""jaxlint corpus: a hand-rolled "span" timing async dispatch inline.
+
+The tempting DIY version of `arena.obs.tracing`: read the clock, issue
+the jitted work, read the clock again, call the difference a "span".
+JAX dispatch is asynchronous, so the second read lands while the
+device is still computing — the recorded span measures dispatch issue,
+not the work, and the trace lies. Rule: timing-without-block.
+
+The real tracing API does not trip this rule — its clock reads live
+inside `_Span.__enter__`/`__exit__`, never interleaved with the
+caller's dispatches, and its spans are documented as HOST-stage
+timings (the honest quantity). `tests/test_analysis_lint.py` pins both
+halves: this file fires the rule; code using `obs.span(...)` does not.
+"""
+
+import time
+
+import jax.numpy as jnp
+
+_SPANS = []
+
+
+def record_epoch_span(x):
+    start = time.perf_counter()
+    y = jnp.dot(x, x)
+    _SPANS.append(("epoch", start, time.perf_counter() - start))
+    return y

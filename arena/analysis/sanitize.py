@@ -25,7 +25,15 @@ layer, `RecompileSentinel(mode="count")` and
 `donation_guard(mode="count", sample_every=N)` fold violations into
 counters (`ArenaServer.stats()` exposes them) instead of raising —
 a long-lived server wants the metric, not the crash. Defaults are
-unchanged: tests still get the loud failure.
+unchanged: tests still get the loud failure. Since the observability
+layer (`arena/obs/`), the serving path watches BOTH jit caches — the
+update fn and the engine's cached bootstrap resampler
+(`num_bootstrap_compiles`) — and absorbs these counters into the
+metrics registry (`arena_recompile_events_total`,
+`arena_donation_*_total`), which is the schema the Prometheus
+`render()`, `stats()`, and the soak bench's zero-recompile HARD gate
+all read. The counters here stay the source; the registry is the
+exposition path.
 
 Everything here imports jax; the linter half of this package does not.
 Keep it that way — lint must run on boxes with no accelerator stack.

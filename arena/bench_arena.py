@@ -87,16 +87,38 @@ sorted, watermarks monotone. A thread-aware `RecompileSentinel`
 asserts zero steady-state compiles across the serve and ingest
 threads; the production-mode sanitizer counters ride in the line.
 
+A fifth mode, ``ARENA_BENCH_MODE=soak``, is the long MIXED-workload
+harness (ROADMAP item 5): overlapped ingest + a concurrent query
+thread + periodic durable snapshots + periodic bootstrap interval
+refreshes, all under the LIVE observability layer (`arena/obs/`). One
+``arena_soak`` JSON line reports p50/p99 query latency, ingest
+throughput, and the queue-depth and staleness distributions — behind
+TWO HARD GATES (rc 2): the production-mode ``recompile_events``
+counter must stay at ZERO across the whole measured window (update,
+bootstrap, packer thread — the compile-free steady-state contract),
+and the final ratings must be equivalent to a sync replay of the same
+stream (plus the serve-mode torn-view invariants per response). A
+third gate class, ``arena_bench_obs_overhead_failure`` (also rc 2),
+rides the ``ingest`` and ``pipeline`` modes: each runs its hot path
+under the NullRegistry AND the live registry (order-alternated per
+repeat) and fails if live regresses more than ``ARENA_BENCH_OBS_TOL``
+(3%; a small absolute floor absorbs scheduler jitter at smoke sizes)
+— instrumented runs must also produce IDENTICAL groupings/ratings.
+
 Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest | pipeline |
-serve),
+serve | soak),
 ARENA_BENCH_MATCHES (100000), ARENA_BENCH_PLAYERS (1000),
 ARENA_BENCH_BATCH (8192), ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED
 (0), ARENA_BENCH_BT_ITERS (25), ARENA_BENCH_TOL (0.5 rating points —
 the equivalence gate), ARENA_BENCH_DELTA (10000, ingest mode; also the
-pipeline mode's streamed batch size), ARENA_BENCH_BT_TOL (0.01, ingest
+pipeline/soak modes' streamed batch size), ARENA_BENCH_BT_TOL (0.01,
+ingest
 mode — chunked-vs-single BT gate), ARENA_BENCH_STREAM_BATCHES (8,
 pipeline mode — streamed batches per repeat), ARENA_BENCH_QUEUE_CAPACITY
-(8, pipeline mode), ARENA_BENCH_BOOTSTRAP_ROUNDS (8, serve mode),
+(8, pipeline/soak modes), ARENA_BENCH_BOOTSTRAP_ROUNDS (8, serve/soak
+modes), ARENA_BENCH_SOAK_BATCHES (16), ARENA_BENCH_SOAK_REFRESH_EVERY
+(4), ARENA_BENCH_SOAK_SNAPSHOT_EVERY (4), ARENA_BENCH_OBS_TOL (0.03),
+ARENA_BENCH_OBS_ABS_S (0.005),
 ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
 sharded path when the backend is not yet initialized).
 """
@@ -130,6 +152,7 @@ import numpy as np  # noqa: E402
 
 import bench  # noqa: E402  (exc_detail — the repo-wide error formatting)
 from arena import baseline, engine, ingest, ratings, serving, sharding  # noqa: E402
+from arena import obs as obs_pkg  # noqa: E402
 from arena.analysis import sanitize  # noqa: E402
 
 # Max |rating diff| tolerated between the naive float64 loop and the
@@ -155,6 +178,53 @@ class EquivalenceError(AssertionError):
         )
         self.max_diff = max_diff
         self.tol = tol
+
+
+# Live-registry instrumentation budget on the measured hot paths,
+# relative to the NullRegistry baseline. The absolute floor keeps
+# smoke-size runs (tens of ms, where 3% is scheduler noise) from
+# flaking; at the acceptance sizes the relative bound is the binding
+# one.
+OBS_OVERHEAD_TOL = 0.03
+OBS_OVERHEAD_ABS_FLOOR_S = 0.005
+
+
+class ObsOverheadError(AssertionError):
+    """The live metrics registry measurably slowed the hot path."""
+
+    def __init__(self, overhead, tol, null_s, live_s):
+        super().__init__(
+            f"live-registry instrumentation overhead {overhead:.2%} exceeds "
+            f"{tol:.0%} (null {null_s:.6f}s vs live {live_s:.6f}s); the "
+            "observability layer must stay off the hot path"
+        )
+        self.overhead = overhead
+        self.tol = tol
+        self.null_s = null_s
+        self.live_s = live_s
+
+
+def _gate_obs_overhead(null_s, live_s):
+    """HARD gate: live-vs-null regression must stay under the relative
+    tolerance (or under the absolute floor — smoke-size noise guard)."""
+    tol = float(os.environ.get("ARENA_BENCH_OBS_TOL", OBS_OVERHEAD_TOL))
+    floor = float(
+        os.environ.get("ARENA_BENCH_OBS_ABS_S", OBS_OVERHEAD_ABS_FLOOR_S)
+    )
+    overhead = live_s / null_s - 1.0
+    if overhead > tol and (live_s - null_s) > floor:
+        raise ObsOverheadError(overhead, tol, null_s, live_s)
+    return {
+        "null_s": round(null_s, 6),
+        "live_s": round(live_s, 6),
+        "overhead_frac": round(overhead, 4),
+        "tolerance": tol,
+        "abs_floor_s": floor,
+    }
+
+
+class SoakGateError(AssertionError):
+    """A soak-bench hard gate failed (recompiles in the steady state)."""
 
 
 def _env_int(name, default):
@@ -358,6 +428,56 @@ def run_ingest_benchmark():
         )
     speedup = cold_pack_s / incremental_merge_s
 
+    # --- instrumentation overhead HARD gate: the WHOLE-SET build
+    # (every add + every LSM compaction — the full instrumented hot
+    # path, a measurement region large enough that 3% is a real
+    # budget, not scheduler jitter) with the LIVE registry recording
+    # must stay within tolerance of the NullRegistry build, and must
+    # produce the IDENTICAL grouping (instrumentation never touches
+    # data). Null and live alternate within each repeat so cache and
+    # scheduler state favor neither side. ----------------------------
+    obs_live = obs_pkg.Observability()
+    all_slices = _batch_slices(total, batch)
+    null_build_s = float("inf")
+    live_build_s = float("inf")
+    built_null = built_live = None
+
+    def _build(csr):
+        t0 = time.perf_counter()
+        for start, stop in all_slices:
+            csr.add(winners[start:stop], losers[start:stop])
+        csr.compact()
+        return time.perf_counter() - t0
+
+    for r in range(repeats):
+        builds = [
+            (ingest.MergeableCSR(num_players), False),
+            (ingest.MergeableCSR(num_players, obs=obs_live), True),
+        ]
+        if r % 2:
+            builds.reverse()
+        for csr, is_live in builds:
+            elapsed = _build(csr)
+            if is_live:
+                live_build_s = min(live_build_s, elapsed)
+                built_live = csr
+            else:
+                null_build_s = min(null_build_s, elapsed)
+                built_null = csr
+    obs_gate = _gate_obs_overhead(null_build_s, live_build_s)
+    tol = float(os.environ.get("ARENA_BENCH_TOL", EQUIVALENCE_TOL))
+    perm_null, bounds_null = built_null.grouping()
+    perm_live, bounds_live = built_live.grouping()
+    if not (
+        np.array_equal(perm_null, perm_live)
+        and np.array_equal(bounds_null, bounds_live)
+    ):
+        raise EquivalenceError(float("inf"), tol)
+    obs_gate["spans_recorded"] = obs_live.tracer.recorded
+    obs_gate["csr_merges_counted"] = obs_live.registry.counter_sum(
+        "arena_ingest_matches_total"
+    )
+
     # --- equivalence gate, Elo: the incremental engine path must land
     # on the same ratings as a cold pack + fused epoch ----------------
     eng = engine.ArenaEngine(num_players)
@@ -451,6 +571,7 @@ def run_ingest_benchmark():
             "staging_slots": eng._staging.slots_allocated,
             "steady_state_new_compiles": 0,  # sentinel raised otherwise
         },
+        "obs": obs_gate,
         "bt": {
             "iters": bt_iters,
             "single_iter_s": round(single_iter_s, 6),
@@ -485,18 +606,25 @@ def run_pipeline_benchmark():
     total = base_matches + stream_batch * (1 + stream_batches * repeats)
     winners, losers = make_matches(total, num_players, seed)
 
-    # Three engines, identical histories: sync ingest (the comparator),
+    # Four engines, identical histories: sync ingest (the comparator),
     # overlapped ingest (the claim), cold per-batch update (the
-    # equivalence anchor — fresh pack_batch allocations, no staging).
+    # equivalence anchor — fresh pack_batch allocations, no staging),
+    # and overlapped ingest under the LIVE metrics registry (the
+    # instrumentation-overhead gate's subject; the other three run the
+    # default NullRegistry, i.e. the pre-instrumentation behavior).
+    obs_live = obs_pkg.Observability()
     eng_sync = engine.ArenaEngine(num_players)
     eng_async = engine.ArenaEngine(num_players)
     eng_cold = engine.ArenaEngine(num_players)
+    eng_obs = engine.ArenaEngine(num_players, obs=obs_live)
     eng_async.start_pipeline(capacity=capacity)
+    eng_obs.start_pipeline(capacity=capacity)
     for start, stop in _batch_slices(base_matches, batch):
         w, l = winners[start:stop], losers[start:stop]
         eng_sync.ingest(w, l)
         eng_async.ingest(w, l)
         eng_cold.update(w, l)
+        eng_obs.ingest(w, l)
 
     # Warmup: the first stream-sized batch touches the stream bucket
     # (one legitimate compile + slot pair per engine) and runs through
@@ -509,14 +637,27 @@ def run_pipeline_benchmark():
     eng_cold.update(w0, l0)
     eng_async.ingest_async(w0, l0)
     eng_async.flush()
+    eng_obs.ingest_async(w0, l0)
+    eng_obs.flush()
 
     sentinel = sanitize.RecompileSentinel(
         sync=eng_sync.num_compiles, overlapped=eng_async.num_compiles
     )
     sync_s = float("inf")
     async_s = float("inf")
+    obs_async_s = float("inf")
     offset = base_matches + stream_batch
-    for _ in range(repeats):
+
+    def _stream_async(eng, slices):
+        """One overlapped stream, flushed — flush() blocks on the
+        ratings, so the wall clock includes the device work."""
+        t0 = time.perf_counter()
+        for start, stop in slices:
+            eng.ingest_async(winners[start:stop], losers[start:stop])
+        eng.flush()
+        return time.perf_counter() - t0
+
+    for r in range(repeats):
         slices = [
             (offset + i * stream_batch, offset + (i + 1) * stream_batch)
             for i in range(stream_batches)
@@ -527,11 +668,18 @@ def run_pipeline_benchmark():
             eng_sync.ingest(winners[start:stop], losers[start:stop])
         jax.block_until_ready(eng_sync.ratings)
         sync_s = min(sync_s, time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        for start, stop in slices:
-            eng_async.ingest_async(winners[start:stop], losers[start:stop])
-        eng_async.flush()  # blocks until ratings are ready
-        async_s = min(async_s, time.perf_counter() - t0)
+        # Null-obs and live-obs streams alternate order per repeat, so
+        # the overhead gate compares runs with symmetric cache and
+        # scheduler state (both engines consume every slice either way).
+        streams = [(eng_async, False), (eng_obs, True)]
+        if r % 2:
+            streams.reverse()
+        for eng_s, is_live in streams:
+            elapsed = _stream_async(eng_s, slices)
+            if is_live:
+                obs_async_s = min(obs_async_s, elapsed)
+            else:
+                async_s = min(async_s, elapsed)
         for start, stop in slices:
             eng_cold.update(winners[start:stop], losers[start:stop])
     # Zero new compiles across EVERY streamed batch on both paths — in
@@ -542,6 +690,7 @@ def run_pipeline_benchmark():
     r_sync = np.asarray(eng_sync.ratings)
     r_async = np.asarray(eng_async.flush())
     r_cold = np.asarray(eng_cold.ratings)
+    r_obs = np.asarray(eng_obs.flush())
     tol = float(os.environ.get("ARENA_BENCH_TOL", EQUIVALENCE_TOL))
     max_async_diff = float(np.abs(r_async - r_sync).max())
     if not max_async_diff < tol:
@@ -549,6 +698,14 @@ def run_pipeline_benchmark():
     max_cold_diff = float(np.abs(r_async - r_cold).max())
     if not max_cold_diff < tol:
         raise EquivalenceError(max_cold_diff, tol)
+    # The instrumented engine consumed the same stream: identical
+    # ratings (instrumentation never touches data) AND within the
+    # overhead budget (HARD gate, rc 2 on breach).
+    if not np.array_equal(r_obs, r_async):
+        raise EquivalenceError(float(np.abs(r_obs - r_async).max()), 0.0)
+    obs_gate = _gate_obs_overhead(async_s, obs_async_s)
+    obs_gate["spans_recorded"] = obs_live.tracer.recorded
+    eng_obs.shutdown()
     speedup = sync_s / async_s
 
     pipe = eng_async._pipeline
@@ -602,6 +759,7 @@ def run_pipeline_benchmark():
             "steady_state_new_compiles": 0,  # sentinel raised otherwise
             "note": note,
         },
+        "obs": obs_gate,
         "equivalence_ok": True,
         "max_rating_diff": round(max_async_diff, 6),
         "max_rating_diff_vs_cold": round(max_cold_diff, 6),
@@ -787,6 +945,203 @@ def run_serve_benchmark():
     }
 
 
+def run_soak_benchmark():
+    """The long mixed-workload soak (ROADMAP item 5's missing harness):
+    concurrent overlapped ingest + a query thread + periodic durable
+    snapshots + periodic bootstrap interval refreshes, all under the
+    LIVE observability layer. One `arena_soak` JSON line reports the
+    p50/p99 query latency, ingest throughput, and the queue-depth and
+    staleness distributions — and TWO HARD GATES (rc 2) stand behind
+    it: `recompile_events` counted by the production-mode sentinel
+    must stay at ZERO across the whole measured window (update,
+    bootstrap, packer thread — a recompile in the serving loop is a
+    multi-second stall for every concurrent reader), and the final
+    ratings must be equivalent to a sync replay of the same stream
+    (plus the serve-mode torn-view invariants on every response)."""
+    base_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
+    stream_batch = _env_int("ARENA_BENCH_DELTA", 10_000)
+    soak_batches = _env_int("ARENA_BENCH_SOAK_BATCHES", 16)
+    refresh_every = _env_int("ARENA_BENCH_SOAK_REFRESH_EVERY", 4)
+    snapshot_every = _env_int("ARENA_BENCH_SOAK_SNAPSHOT_EVERY", 4)
+    num_players = _env_int("ARENA_BENCH_PLAYERS", 1_000)
+    batch = _env_int("ARENA_BENCH_BATCH", 8_192)
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+    capacity = _env_int("ARENA_BENCH_QUEUE_CAPACITY", 8)
+    bootstrap_rounds = _env_int("ARENA_BENCH_BOOTSTRAP_ROUNDS", 8)
+    tol = float(os.environ.get("ARENA_BENCH_TOL", EQUIVALENCE_TOL))
+
+    total = base_matches + stream_batch * (1 + soak_batches)
+    winners, losers = make_matches(total, num_players, seed)
+    # Pin the bootstrap epoch padding to the soak's full horizon: every
+    # interval refresh in the measured window then reuses ONE compiled
+    # resampler no matter how far history has grown.
+    min_epoch_batches = engine._pow2_ceil(-(-total // batch))
+
+    obs_live = obs_pkg.Observability(trace_capacity=8192)
+    srv = serving.ArenaServer(
+        num_players=num_players,
+        max_staleness_matches=stream_batch,
+        bootstrap_rounds=bootstrap_rounds,
+        obs=obs_live,
+    )
+    eng = srv.engine
+    for start, stop in _batch_slices(base_matches, batch):
+        eng.ingest(winners[start:stop], losers[start:stop])
+    pipe = eng.start_pipeline(capacity=capacity)
+
+    # Warmup — every legitimate compile happens HERE, outside the
+    # gated window: the stream bucket, the horizon-padded bootstrap
+    # epoch, the first serving view.
+    w0 = winners[base_matches : base_matches + stream_batch]
+    l0 = losers[base_matches : base_matches + stream_batch]
+    eng.ingest_async(w0, l0)
+    eng.flush()
+    srv.refresh_intervals(batch_size=batch, min_epoch_batches=min_epoch_batches)
+    query_ids = list(range(0, num_players, max(1, num_players // 8)))
+    srv.query(leaderboard=(0, 10), players=query_ids, pairs=[(0, 1)])
+    recompiles_after_warmup = srv.stats()["recompile_events"]
+
+    h_depth = obs_live.histogram("arena_pipeline_queue_depth", base=1.0)
+    lat_hist = obs_live.histogram("arena_query_latency_seconds")
+    stale_hist = obs_live.histogram("arena_query_staleness_matches", base=1.0)
+    base_mass = num_players * float(ratings.DEFAULT_BASE)
+    stop_event = threading.Event()
+    torn = []
+    counts = {"queries": 0}
+    max_mass_dev = [0.0]
+
+    def reader():
+        last_watermark = 0
+        while not stop_event.is_set():
+            resp = srv.query(
+                leaderboard=(0, 10), players=query_ids, pairs=[(0, 1)]
+            )
+            counts["queries"] += 1
+            page = [row["rating"] for row in resp["leaderboard"]]
+            if page != sorted(page, reverse=True):
+                torn.append("unsorted leaderboard page")
+                return
+            dev = abs(resp["view_ratings_sum"] - base_mass) / num_players
+            max_mass_dev[0] = max(max_mass_dev[0], dev)
+            if resp["watermark"] < last_watermark:
+                torn.append("watermark went backwards")
+                return
+            last_watermark = resp["watermark"]
+
+    snap_root = pathlib.Path(tempfile.mkdtemp(prefix="arena-soak-bench-"))
+    snapshots_taken = 0
+    refreshes_done = 0
+    reader_thread = threading.Thread(target=reader, daemon=True)
+    offset = base_matches + stream_batch
+    try:
+        t0 = time.perf_counter()
+        reader_thread.start()
+        for i in range(soak_batches):
+            start = offset + i * stream_batch
+            eng.ingest_async(
+                winners[start : start + stream_batch],
+                losers[start : start + stream_batch],
+            )
+            h_depth.record(pipe.pending())
+            if (i + 1) % refresh_every == 0:
+                srv.refresh_intervals(
+                    batch_size=batch, min_epoch_batches=min_epoch_batches
+                )
+                refreshes_done += 1
+            if (i + 1) % snapshot_every == 0:
+                srv.snapshot(snap_root / "snap")
+                snapshots_taken += 1
+        eng.flush()
+        jax.block_until_ready(eng.ratings)
+        ingest_s = time.perf_counter() - t0
+        stop_event.set()
+        reader_thread.join(timeout=60.0)
+        elapsed = time.perf_counter() - t0
+        stats = srv.stats()
+    finally:
+        stop_event.set()
+        shutil.rmtree(snap_root, ignore_errors=True)
+    soak_recompiles = stats["recompile_events"] - recompiles_after_warmup
+
+    # --- sync replay of the SAME stream (the equivalence anchor) -----
+    eng_sync = engine.ArenaEngine(num_players)
+    for start, stop in _batch_slices(base_matches, batch):
+        eng_sync.ingest(winners[start:stop], losers[start:stop])
+    eng_sync.ingest(w0, l0)
+    for i in range(soak_batches):
+        start = offset + i * stream_batch
+        eng_sync.ingest(
+            winners[start : start + stream_batch],
+            losers[start : start + stream_batch],
+        )
+    max_diff = float(
+        np.abs(np.asarray(eng.ratings) - np.asarray(eng_sync.ratings)).max()
+    )
+
+    # --- the soak HARD gates: equivalence, torn views, zero recompiles
+    # (rc 2 on any breach — the mutation audit carries the gate-skipped
+    # mutant; test_soak_bench_gate_is_hard is its named kill) ----------
+    if not max_diff < tol:
+        raise EquivalenceError(max_diff, tol)
+    if torn or not max_mass_dev[0] < tol:
+        raise EquivalenceError(float("inf"), tol)
+    if soak_recompiles != 0:
+        raise SoakGateError(
+            f"{soak_recompiles} recompile event(s) counted during the "
+            "soak's steady state; the compile-free contract (ROADMAP "
+            "item 5) promises zero"
+        )
+
+    streamed = stream_batch * soak_batches
+    p50 = lat_hist.percentile(0.5)
+    p99 = lat_hist.percentile(0.99)
+    return {
+        "metric": "arena_soak",
+        "value": round(p99 * 1e3, 3) if p99 is not None else -1,
+        "unit": "p99_query_latency_ms",
+        "vs_baseline": None,
+        "params": {
+            "base_matches": base_matches,
+            "stream_batch": stream_batch,
+            "soak_batches": soak_batches,
+            "refresh_every": refresh_every,
+            "snapshot_every": snapshot_every,
+            "num_players": num_players,
+            "batch_size": batch,
+            "seed": seed,
+            "queue_capacity": capacity,
+            "bootstrap_rounds": bootstrap_rounds,
+            "max_staleness_matches": stream_batch,
+            "host_cores": os.cpu_count() or 1,
+        },
+        "soak": {
+            "elapsed_s": round(elapsed, 6),
+            "queries": counts["queries"],
+            "queries_per_s": round(counts["queries"] / elapsed, 2),
+            "query_latency_ms": {
+                "p50": round(p50 * 1e3, 3) if p50 is not None else None,
+                "p99": round(p99 * 1e3, 3) if p99 is not None else None,
+                "count": lat_hist.count,
+            },
+            "ingest_stream_s": round(ingest_s, 6),
+            "stream_matches_per_s": round(streamed / ingest_s),
+            "queue_depth": h_depth.snapshot(),
+            "staleness_matches": stale_hist.snapshot(),
+            "interval_refreshes": refreshes_done,
+            "snapshots": snapshots_taken,
+            "recompile_events": soak_recompiles,
+            "donation_skipped": stats["donation_skipped"],
+            "dropped_batches": stats["pipeline"]["dropped_batches"],
+            "spilled_batches": stats["pipeline"]["spilled_batches"],
+            "trace_spans_recorded": obs_live.tracer.recorded,
+            "trace_dropped": obs_live.tracer.dropped,
+            "max_view_mass_dev": round(max_mass_dev[0], 6),
+        },
+        "equivalence_ok": True,
+        "max_rating_diff": round(max_diff, 6),
+    }
+
+
 def main() -> int:
     rc = 0
     mode = os.environ.get("ARENA_BENCH_MODE", "elo")
@@ -794,6 +1149,7 @@ def main() -> int:
         "ingest": (run_ingest_benchmark, "x_vs_cold_repack"),
         "pipeline": (run_pipeline_benchmark, "x_vs_sync_ingest"),
         "serve": (run_serve_benchmark, "queries_per_s"),
+        "soak": (run_soak_benchmark, "p99_query_latency_ms"),
     }
     runner, unit = runners.get(mode, (run_benchmark, "x_vs_naive_baseline"))
     try:
@@ -810,6 +1166,37 @@ def main() -> int:
                 "vs_baseline": None,
                 "max_rating_diff": round(exc.max_diff, 6),
                 "tolerance": exc.tol,
+                "error": str(exc),
+            }
+        )
+        rc = EXIT_EQUIVALENCE_FAILURE
+    except ObsOverheadError as exc:
+        # Same measured-verdict discipline: the instrumentation layer
+        # measurably slowed the hot path, so the line carries the
+        # regression instead of a speedup and the process exits rc 2.
+        line = json.dumps(
+            {
+                "metric": "arena_bench_obs_overhead_failure",
+                "value": -1,
+                "unit": unit,
+                "vs_baseline": None,
+                "overhead_frac": round(exc.overhead, 4),
+                "tolerance": exc.tol,
+                "null_s": round(exc.null_s, 6),
+                "live_s": round(exc.live_s, 6),
+                "error": str(exc),
+            }
+        )
+        rc = EXIT_EQUIVALENCE_FAILURE
+    except SoakGateError as exc:
+        # The soak's zero-recompile contract broke: a measured verdict
+        # (the counter moved), never a crash.
+        line = json.dumps(
+            {
+                "metric": "arena_bench_soak_gate_failure",
+                "value": -1,
+                "unit": unit,
+                "vs_baseline": None,
                 "error": str(exc),
             }
         )

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from arena import ratings as R
+from arena.obs import NULL as NULL_OBS
 
 # Floor keeps tiny batches from generating one bucket per power of two
 # at the small end where padding is nearly free anyway.
@@ -136,12 +137,33 @@ class PackedEpoch(NamedTuple):
     num_real: int
 
 
-def pack_epoch(num_players, winners, losers, batch_size, dtype=np.float32):
+def _pow2_ceil(n):
+    """Smallest power of two >= n (n >= 1)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def pack_epoch(num_players, winners, losers, batch_size, dtype=np.float32,
+               pad_batches_pow2=False, min_batches=None):
     """Split a match set into fixed-size batches and pack each one.
 
     The last batch is padded to `batch_size` (the scan needs one fixed
     shape). Grouping cost is one counting sort per batch — amortized
     over every epoch/iteration run against the result.
+
+    `pad_batches_pow2=True` additionally pads the NUMBER of batches up
+    to a power of two (floored at `min_batches` when given) with fully
+    invalid batches — all-zero indices, valid == 0, so every padded
+    batch is a rating no-op. This is the epoch-level twin of the pow2
+    bucket contract: a jitted epoch consumer (the bootstrap resampler)
+    then sees O(log N) distinct shapes as history grows instead of one
+    per batch count — the `refresh_intervals` recompile source ROADMAP
+    item 5 names. Callers that want a longer compile-free horizon pass
+    `min_batches` = the padded count of the largest epoch they plan to
+    serve (the soak bench pins its whole run to one executable this
+    way).
     """
     winners = np.asarray(winners, dtype=np.int32)
     losers = np.asarray(losers, dtype=np.int32)
@@ -150,6 +172,8 @@ def pack_epoch(num_players, winners, losers, batch_size, dtype=np.float32):
     if n == 0:
         raise ValueError("cannot pack an empty match set")
     nb = -(-n // batch_size)
+    if pad_batches_pow2:
+        nb = _pow2_ceil(max(nb, min_batches or 1))
     pad = nb * batch_size - n
     w = np.concatenate([winners, np.zeros(pad, np.int32)]).reshape(nb, batch_size)
     l = np.concatenate([losers, np.zeros(pad, np.int32)]).reshape(nb, batch_size)
@@ -187,6 +211,7 @@ class ArenaEngine:
         base=R.DEFAULT_BASE,
         min_bucket=MIN_BUCKET,
         dtype=jnp.float32,
+        obs=None,
     ):
         if num_players < 2:
             raise ValueError("an arena needs at least two players")
@@ -196,6 +221,12 @@ class ArenaEngine:
         self.base = base
         self.min_bucket = min_bucket
         self._dtype = dtype
+        # Observability (arena.obs.Observability). Defaults to the
+        # shared no-op instance: an engine nobody asked to measure
+        # pays constant-time null calls, records nothing, allocates
+        # nothing — and the bench hard-gates that even the LIVE
+        # registry stays under 3% on the ingest/pipeline paths.
+        self.obs = obs if obs is not None else NULL_OBS
         self.ratings = jnp.full((num_players,), base, dtype)
         # ONE match store serves every path: update() and ingest()
         # both feed the mergeable CSR, so Bradley–Terry refits (single
@@ -206,7 +237,7 @@ class ArenaEngine:
         from arena import ingest as ingest_mod
 
         self._ingest_mod = ingest_mod
-        self._store = ingest_mod.MergeableCSR(num_players)
+        self._store = ingest_mod.MergeableCSR(num_players, obs=self.obs)
         self._staging = None  # built on first ingest()
         self._pipeline = None  # built on first ingest_async()
         # Matches whose rating update has been DISPATCHED — the serving
@@ -222,13 +253,31 @@ class ArenaEngine:
             partial(R.elo_batch_update_sorted, k=k, scale=scale),
             donate_argnums=(0,),
         )
+        # The bootstrap resampler is jitted ONCE per engine (k/scale
+        # are fixed at construction). A fresh jax.jit wrapper per
+        # refresh — the old shape of this code — re-traced and
+        # re-COMPILED on every interval refresh no matter how carefully
+        # the epoch shapes were padded; one cached wrapper plus the
+        # pow2-padded epoch layout is what makes interval refreshes
+        # compile-free in steady state (ROADMAP item 5, soak-gated).
+        self._bootstrap_fn = R.jit_elo_bootstrap(k=k, scale=scale)
+
+    def set_obs(self, obs):
+        """Re-point the engine (and its store/staging) at a new
+        observability handle — how `ArenaServer` upgrades a default
+        null-instrumented engine to its live registry. The pipeline
+        reads `engine.obs` per event, so it needs no rewiring."""
+        self.obs = obs
+        self._store._obs = obs
+        if self._staging is not None:
+            self._staging._obs = obs
 
     @property
     def matches_ingested(self):
         return self._store.num_matches
 
     def _apply(self, packed):
-        with self._view_lock:
+        with self.obs.span("engine.jit_dispatch"), self._view_lock:
             self.ratings = self._update(
                 self.ratings,
                 packed.winners,
@@ -284,7 +333,7 @@ class ArenaEngine:
     def _ensure_staging(self):
         if self._staging is None:
             self._staging = self._ingest_mod.StagingBuffers(
-                self.num_players, self.min_bucket, np.float32
+                self.num_players, self.min_bucket, np.float32, obs=self.obs
             )
         return self._staging
 
@@ -298,10 +347,11 @@ class ArenaEngine:
         """Apply one staged batch and retire its staging slot — the
         dispatch half of the pipeline, and the same pairing the sync
         path uses, so slot lifetime is identical on both."""
-        try:
-            return self._apply(packed)
-        finally:
-            self._staging.release()
+        with self.obs.span("engine.apply"):
+            try:
+                return self._apply(packed)
+            finally:
+                self._staging.release()
 
     def ingest(self, winners, losers):
         """`update` on the incremental path: the batch is packed
@@ -431,7 +481,8 @@ class ArenaEngine:
             win_counts,
         )
 
-    def bootstrap_ratings(self, num_rounds=32, seed=0, batch_size=8192):
+    def bootstrap_ratings(self, num_rounds=32, seed=0, batch_size=8192,
+                          min_batches=None):
         """Bootstrap rating samples: `num_rounds` Poisson-resampled
         epochs over the full ingested history, vmapped over a seeded
         key array (`ratings.elo_bootstrap`). Each round replays the
@@ -445,7 +496,13 @@ class ArenaEngine:
         Epoch batch boundaries here are `batch_size` re-splits of the
         history, not the original ingest boundaries — the bootstrap
         measures resampling uncertainty, not a bit-exact replay (the
-        crash-restart property owns that)."""
+        crash-restart property owns that). The batch COUNT is padded
+        to a power of two (fully-invalid no-op batches) and the
+        resampler jit is cached per engine, so refreshing intervals as
+        history grows compiles O(log N) times total, not once per
+        refresh — `min_batches` extends the padding to a planned
+        horizon for a strictly compile-free window (the soak bench's
+        zero-recompile gate rides this)."""
         self._drain_pipeline()
         if self._store.num_matches == 0:
             raise ValueError("no matches ingested")
@@ -456,10 +513,11 @@ class ArenaEngine:
             self._store.winners(),
             self._store.losers(),
             batch_size,
+            pad_batches_pow2=True,
+            min_batches=min_batches,
         )
         keys = jax.random.split(jax.random.PRNGKey(seed), num_rounds)
-        fn = R.jit_elo_bootstrap(k=self.k, scale=self.scale)
-        samples = fn(
+        samples = self._bootstrap_fn(
             jnp.full((self.num_players,), self.base, self._dtype),
             packed.winners,
             packed.losers,
@@ -474,6 +532,13 @@ class ArenaEngine:
         """Jit-cache size of the update fn — the recompile budget the
         bucketing exists to cap (one entry per bucket ever touched)."""
         return self._update._cache_size()
+
+    def num_bootstrap_compiles(self):
+        """Jit-cache size of the cached bootstrap resampler — with the
+        pow2-padded epoch layout this grows O(log history), and stays
+        FLAT across interval refreshes within a padded horizon (the
+        serving sentinel and the soak bench watch it)."""
+        return self._bootstrap_fn._cache_size()
 
     def leaderboard(self, top_k=None):
         """(player_id, rating) pairs, best first (async work drained)."""

@@ -71,6 +71,7 @@ from arena.engine import (
     _validate_matches,
     bucket_size,
 )
+from arena.obs import NULL as NULL_OBS
 
 # Floor on the tail entries (2 per match) tolerated before a galloping
 # merge folds the delta into the main runs. The live limit is
@@ -138,6 +139,7 @@ class MergeableCSR:
         num_players,
         compact_threshold=DEFAULT_COMPACT_THRESHOLD,
         size_ratio=DEFAULT_SIZE_RATIO,
+        obs=None,
     ):
         if num_players < 2:
             raise ValueError("an arena needs at least two players")
@@ -146,6 +148,10 @@ class MergeableCSR:
         self.num_players = num_players
         self.compact_threshold = compact_threshold
         self.size_ratio = size_ratio
+        # Observability handle (arena.obs.Observability); defaults to
+        # the shared no-op instance, so an uninstrumented store pays a
+        # constant-time null call per batch, never a measurement.
+        self._obs = obs if obs is not None else NULL_OBS
         self.num_matches = 0
         self.compactions = 0
         # One lock covers every mutation AND clone(): the pipeline's
@@ -199,8 +205,10 @@ class MergeableCSR:
 
     def add(self, winners, losers):
         """Merge one batch: O(d log d) sort of the delta, deferred
-        linear galloping merge. Returns the number of matches added."""
-        with self._lock:
+        linear galloping merge. Returns the number of matches added.
+        The span covers lock wait + delta sort (+ any compaction the
+        add triggers, which records its own nested span)."""
+        with self._obs.span("ingest.csr_merge"), self._lock:
             return self._add_locked(winners, losers)
 
     def _add_locked(self, winners, losers):
@@ -222,6 +230,7 @@ class MergeableCSR:
         self._tail_pos.append(pos[order])
         self._tail_entries += 2 * d
         self.num_matches += d
+        self._obs.counter("arena_ingest_matches_total").inc(d)
         if self._tail_entries > self._compact_limit():
             self._compact_locked()
         return d
@@ -236,16 +245,18 @@ class MergeableCSR:
     def _compact_locked(self):
         if not self._tail_keys:
             return
-        tail_k = np.concatenate(self._tail_keys)
-        tail_p = np.concatenate(self._tail_pos)
-        order = np.argsort(tail_k, kind="stable").astype(np.int64)
-        self._keys, self._pos = _gallop_merge(
-            self._keys, self._pos, tail_k[order], tail_p[order]
-        )
-        self._tail_keys = []
-        self._tail_pos = []
-        self._tail_entries = 0
-        self.compactions += 1
+        with self._obs.span("ingest.compaction"):
+            tail_k = np.concatenate(self._tail_keys)
+            tail_p = np.concatenate(self._tail_pos)
+            order = np.argsort(tail_k, kind="stable").astype(np.int64)
+            self._keys, self._pos = _gallop_merge(
+                self._keys, self._pos, tail_k[order], tail_p[order]
+            )
+            self._tail_keys = []
+            self._tail_pos = []
+            self._tail_entries = 0
+            self.compactions += 1
+            self._obs.counter("arena_ingest_compactions_total").inc()
 
     def grouping(self):
         """Merged `(perm, bounds)` over all `2*num_matches` entries.
@@ -302,7 +313,7 @@ class MergeableCSR:
             }
 
     @classmethod
-    def from_state(cls, num_players, state):
+    def from_state(cls, num_players, state, obs=None):
         """Rebuild a store from `export_state` output WITHOUT re-sorting:
         the main runs and each tail run are installed as-is (they were
         sorted when exported; restore trusts the arrays only after the
@@ -313,6 +324,7 @@ class MergeableCSR:
             num_players,
             compact_threshold=int(state["compact_threshold"]),
             size_ratio=int(state["size_ratio"]),
+            obs=obs,
         )
         n = int(state["num_matches"])
         keys = np.asarray(state["keys"], np.int32)
@@ -357,15 +369,19 @@ class MergeableCSR:
         csr._l[:n] = l
         return csr
 
-    def clone(self):
+    def clone(self, obs=None):
         """Independent copy (bench baseline-vs-delta runs; also the
         seed of the snapshot/restore the serving layer will need).
         Snapshots under the same lock the pipeline's packer merges
         under, so a clone taken while a compaction is in flight on
-        another thread is still a consistent structure."""
+        another thread is still a consistent structure. `obs` rewires
+        the copy's observability handle (the bench's overhead gate
+        clones one base into a null-instrumented and a live-
+        instrumented run); default inherits the source's."""
         with self._lock:
             other = MergeableCSR(
-                self.num_players, self.compact_threshold, self.size_ratio
+                self.num_players, self.compact_threshold, self.size_ratio,
+                obs=obs if obs is not None else self._obs,
             )
             other.num_matches = self.num_matches
             other.compactions = self.compactions
@@ -415,13 +431,15 @@ class StagingBuffers:
     pipeline's packer thread does while the main thread drains).
     """
 
-    def __init__(self, num_players, min_bucket=MIN_BUCKET, dtype=np.float32, depth=2):
+    def __init__(self, num_players, min_bucket=MIN_BUCKET, dtype=np.float32,
+                 depth=2, obs=None):
         if depth < 2:
             raise ValueError("double buffering needs at least two slots per bucket")
         self.num_players = num_players
         self.min_bucket = min_bucket
         self.depth = depth
         self._dtype = dtype
+        self._obs = obs if obs is not None else NULL_OBS
         self._rings = {}  # bucket -> list of slots
         self._next = {}  # bucket -> rotation index
         self._cond = threading.Condition()
@@ -472,6 +490,10 @@ class StagingBuffers:
 
     def stage(self, winners, losers, block=False):
         """Pack one validated batch through a reusable slot."""
+        with self._obs.span("ingest.staging"):
+            return self._stage(winners, losers, block)
+
+    def _stage(self, winners, losers, block):
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
         _validate_matches(self.num_players, w, l)

@@ -1,0 +1,105 @@
+"""arena.obs — zero-dependency observability: metrics + stage tracing.
+
+The measurement substrate every subsystem reports through (and every
+later PR — network tier, replicas, multi-host — will report through):
+
+- `arena.obs.metrics`  — thread-safe registry of counters, gauges, and
+  fixed-bucket log2 histograms over preallocated numpy arrays, with a
+  Prometheus-style text `render()` and a one-JSON-line `dump()`.
+- `arena.obs.tracing`  — monotonic-clock stage spans in a bounded
+  overwrite-oldest ring buffer, exportable as Chrome trace-event JSON.
+
+`Observability` bundles one registry + one tracer behind the small
+surface the instrumented modules call (`span`/`counter`/`gauge`/
+`histogram`/`dump`/`render`), and `NULL` is the shared no-op instance:
+every call is a constant-time no-op, nothing allocates, nothing is
+recorded. `ArenaEngine` defaults to `NULL` (a library user who never
+asked for metrics pays a method call, not a measurement — and the
+bench hard-gates that the LIVE registry costs < 3% on the ingest and
+pipeline paths, so turning it on is cheap too). `ArenaServer` defaults
+to a live instance: a serving surface without latency percentiles and
+drop counters cannot stand behind any load-shedding policy.
+
+Nothing in this package imports jax — it must load (and its tests must
+run) on boxes with no accelerator stack, the same rule as the linter
+half of `arena/analysis`.
+"""
+
+from arena.obs.metrics import (
+    DEFAULT_LATENCY_BASE,
+    DEFAULT_NUM_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    NullRegistry,
+    Registry,
+)
+from arena.obs.tracing import NullTracer, Tracer
+
+
+class Observability:
+    """One registry + one tracer, behind the instrumentation surface."""
+
+    enabled = True
+
+    def __init__(self, registry=None, tracer=None, trace_capacity=4096):
+        self.registry = registry if registry is not None else Registry()
+        self.tracer = tracer if tracer is not None else Tracer(trace_capacity)
+
+    # --- delegation (the only calls instrumented modules make) -------
+
+    def span(self, name):
+        return self.tracer.span(name)
+
+    def counter(self, name, **labels):
+        return self.registry.counter(name, **labels)
+
+    def gauge(self, name, **labels):
+        return self.registry.gauge(name, **labels)
+
+    def histogram(self, name, base=DEFAULT_LATENCY_BASE,
+                  num_buckets=DEFAULT_NUM_BUCKETS, **labels):
+        return self.registry.histogram(
+            name, base=base, num_buckets=num_buckets, **labels
+        )
+
+    def render(self):
+        """Prometheus text exposition of the registry."""
+        return self.registry.render()
+
+    def dump(self):
+        """One JSON-able dict: metrics + trace accounting."""
+        out = self.registry.dump()
+        out["trace"] = {
+            "spans_recorded": self.tracer.recorded,
+            "trace_dropped": self.tracer.dropped,
+            "capacity": self.tracer.capacity,
+        }
+        return out
+
+
+class _NullObservability(Observability):
+    """The shared no-op instance behind `NULL` (not for direct
+    construction — use `NULL`)."""
+
+    enabled = False
+
+    def __init__(self):
+        super().__init__(registry=NullRegistry(), tracer=NullTracer())
+
+
+NULL = _NullObservability()
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "NULL",
+    "NullRegistry",
+    "NullTracer",
+    "Observability",
+    "Registry",
+    "Tracer",
+    "DEFAULT_LATENCY_BASE",
+    "DEFAULT_NUM_BUCKETS",
+]

@@ -1,0 +1,362 @@
+"""Metrics registry: counters, gauges, and log2 latency histograms.
+
+The measurement half of `arena/obs/` (the other half is
+`arena/obs/tracing.py`). Design constraints, in order:
+
+1. **Hot-path cheap.** A histogram record is one vectorized
+   `searchsorted` into a preallocated bounds array plus two in-place
+   adds into preallocated numpy buffers — no allocation after
+   construction. Locks are PER METRIC, never registry-wide, so two
+   threads recording into different metrics never contend; the
+   registry's own lock is taken only at get-or-create time (cold
+   path). The per-metric lock is what makes concurrent increments sum
+   EXACTLY (a bare `arr[0] += 1` is a read-modify-write that loses
+   updates under threads; the tier-1 concurrency test pins exactness).
+
+2. **Fixed memory.** Histograms are fixed-bucket log2: upper bounds
+   `base * 2**i` for `num_buckets` buckets plus one overflow slot.
+   Bucket semantics are Prometheus-style `le` (a value lands in the
+   FIRST bucket whose upper bound is >= it, so a value exactly on a
+   boundary belongs to that boundary's bucket — pinned by a boundary
+   test and policed by a mutation-audit mutant). Percentiles are read
+   from the cumulative counts and reported as the containing bucket's
+   upper bound — a conservative (never under-reporting) estimate with
+   log2 resolution, which is what a latency SLO check wants.
+
+3. **A no-op twin.** `NullRegistry` serves the identical interface
+   from singletons whose every method is a constant-time no-op — the
+   uninstrumented baseline the bench overhead gate compares against
+   (`arena/bench_arena.py` hard-gates live-vs-null regression < 3%),
+   and the default for `ArenaEngine` so library users who never asked
+   for metrics pay a method call, not a measurement.
+
+No jax anywhere in this package: metrics must be importable (and
+testable) on boxes with no accelerator stack, same discipline as the
+linter half of `arena/analysis`.
+"""
+
+import json
+import threading
+
+import numpy as np
+
+# Default histogram shape: 32 log2 buckets from 1us up (~4295s at the
+# top) covers any host-stage latency this system can produce; value
+# histograms (queue depth, staleness) pass base=1.
+DEFAULT_LATENCY_BASE = 1e-6
+DEFAULT_NUM_BUCKETS = 32
+
+
+def _label_suffix(labels):
+    """Stable `{k="v",...}` rendering (sorted keys), "" when unlabeled."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    return "{" + inner + "}"
+
+
+class Counter:
+    """Monotone integer counter; `inc` is exact under concurrency."""
+
+    __slots__ = ("name", "labels", "_buf", "_lock")
+
+    def __init__(self, name, labels):
+        self.name = name
+        self.labels = labels
+        self._buf = np.zeros(1, np.int64)  # preallocated, never resized
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._buf[0] += n
+
+    @property
+    def value(self):
+        return int(self._buf[0])
+
+
+class Gauge:
+    """Last-write-wins float value (queue depth, staleness, ...)."""
+
+    __slots__ = ("name", "labels", "_buf", "_lock")
+
+    def __init__(self, name, labels):
+        self.name = name
+        self.labels = labels
+        self._buf = np.zeros(1, np.float64)
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._buf[0] = v
+
+    @property
+    def value(self):
+        return float(self._buf[0])
+
+
+class Histogram:
+    """Fixed-bucket log2 histogram over preallocated numpy arrays.
+
+    Bucket i (0-based) has upper bound `base * 2**i`; a recorded value
+    lands in the first bucket whose bound is >= it (`le` semantics —
+    boundary values belong to their boundary's bucket). Values above
+    the last bound land in the overflow slot (rendered `le="+Inf"`).
+    Zero/negative values land in bucket 0 (latencies and depths are
+    non-negative; a clock hiccup must not throw).
+    """
+
+    __slots__ = ("name", "labels", "base", "bounds", "_counts", "_sum",
+                 "_count", "_lock")
+
+    def __init__(self, name, labels, base=DEFAULT_LATENCY_BASE,
+                 num_buckets=DEFAULT_NUM_BUCKETS):
+        if base <= 0 or num_buckets < 1:
+            raise ValueError(
+                f"histogram needs base > 0 and num_buckets >= 1, got "
+                f"({base}, {num_buckets})"
+            )
+        self.name = name
+        self.labels = labels
+        self.base = base
+        self.bounds = base * np.exp2(np.arange(num_buckets, dtype=np.float64))
+        self._counts = np.zeros(num_buckets + 1, np.int64)  # [+Inf] last
+        self._sum = np.zeros(1, np.float64)
+        self._count = np.zeros(1, np.int64)
+        self._lock = threading.Lock()
+
+    def bucket_index(self, value):
+        """First bucket whose upper bound is >= value (le semantics);
+        len(bounds) for overflow."""
+        return int(np.searchsorted(self.bounds, value, side="left"))
+
+    def record(self, value):
+        idx = self.bucket_index(value)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum[0] += value
+            self._count[0] += 1
+
+    @property
+    def count(self):
+        return int(self._count[0])
+
+    @property
+    def sum(self):
+        return float(self._sum[0])
+
+    def percentile(self, q):
+        """Upper bound of the bucket containing quantile q in [0, 1].
+
+        Conservative by construction: the true quantile is <= the
+        returned bound (within the overflow bucket it returns +inf —
+        an honest "past the histogram's range", never a fabricated
+        finite number). None when the histogram is empty.
+        """
+        with self._lock:
+            total = int(self._count[0])
+            if total == 0:
+                return None
+            target = q * total
+            cum = np.cumsum(self._counts)
+            idx = int(np.searchsorted(cum, target, side="left"))
+        if idx >= self.bounds.size:
+            return float("inf")
+        return float(self.bounds[idx])
+
+    def snapshot(self):
+        """JSON-able summary: count, sum, p50/p99, per-bucket counts."""
+        with self._lock:
+            counts = self._counts.copy()
+            total = int(self._count[0])
+            s = float(self._sum[0])
+        out = {
+            "count": total,
+            "sum": round(s, 9),
+            "buckets": {
+                f"{float(b):g}": int(c)
+                for b, c in zip(self.bounds, counts[:-1])
+                if c
+            },
+            "overflow": int(counts[-1]),
+        }
+        for name, q in (("p50", 0.5), ("p99", 0.99)):
+            p = self.percentile(q)
+            out[name] = None if p is None else (
+                p if p != float("inf") else "inf"
+            )
+        return out
+
+
+class Registry:
+    """Thread-safe get-or-create home for all metrics of one system.
+
+    Metric identity is `(name, sorted label items)`; getting an
+    existing metric is one dict lookup under the registry lock (cold
+    path only — callers hold onto the returned metric for the hot
+    path, or accept the lookup cost for occasional records).
+    """
+
+    enabled = True
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, labels, **kwargs):
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            metric = self._metrics.get(key)
+            if metric is None:
+                metric = cls(name, labels, **kwargs)
+                self._metrics[key] = metric
+            elif not isinstance(metric, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(metric).__name__}, not {cls.__name__}"
+                )
+            return metric
+
+    def counter(self, name, **labels):
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name, **labels):
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name, base=DEFAULT_LATENCY_BASE,
+                  num_buckets=DEFAULT_NUM_BUCKETS, **labels):
+        return self._get(Histogram, name, labels, base=base,
+                         num_buckets=num_buckets)
+
+    def _sorted_metrics(self):
+        with self._lock:
+            items = list(self._metrics.items())
+        return sorted(items, key=lambda kv: (kv[0][0], kv[0][1]))
+
+    def counter_sum(self, name):
+        """Sum of one counter name's value across every label set (0
+        when it never fired) — how `stats()` folds policy-labeled
+        counters into a single headline number."""
+        total = 0
+        for (n, _labels), metric in self._sorted_metrics():
+            if n == name and isinstance(metric, Counter):
+                total += metric.value
+        return total
+
+    def render(self):
+        """Prometheus text exposition (the endpoint-ready form)."""
+        lines = []
+        typed = set()
+        for (name, _labels), metric in self._sorted_metrics():
+            kind = {"Counter": "counter", "Gauge": "gauge",
+                    "Histogram": "histogram"}[type(metric).__name__]
+            if name not in typed:
+                lines.append(f"# TYPE {name} {kind}")
+                typed.add(name)
+            suffix = _label_suffix(metric.labels)
+            if isinstance(metric, Histogram):
+                with metric._lock:
+                    counts = metric._counts.copy()
+                    total = int(metric._count[0])
+                    s = float(metric._sum[0])
+                cum = 0
+                for bound, c in zip(metric.bounds, counts[:-1]):
+                    cum += int(c)
+                    le = _label_suffix({**metric.labels, "le": f"{float(bound):g}"})
+                    lines.append(f"{name}_bucket{le} {cum}")
+                le = _label_suffix({**metric.labels, "le": "+Inf"})
+                lines.append(f"{name}_bucket{le} {total}")
+                lines.append(f"{name}_sum{suffix} {s:g}")
+                lines.append(f"{name}_count{suffix} {total}")
+            else:
+                lines.append(f"{name}{suffix} {metric.value:g}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump(self):
+        """One JSON-able dict of everything (the stats()/bench form)."""
+        counters, gauges, histograms = {}, {}, {}
+        for (name, _labels), metric in self._sorted_metrics():
+            key = name + _label_suffix(metric.labels)
+            if isinstance(metric, Counter):
+                counters[key] = metric.value
+            elif isinstance(metric, Gauge):
+                gauges[key] = metric.value
+            else:
+                histograms[key] = metric.snapshot()
+        return {"counters": counters, "gauges": gauges,
+                "histograms": histograms}
+
+    def dump_json(self):
+        return json.dumps(self.dump())
+
+
+class _NullCounter:
+    name = "null"
+    labels = {}
+    value = 0
+
+    def inc(self, n=1):
+        return None
+
+
+class _NullGauge:
+    name = "null"
+    labels = {}
+    value = 0.0
+
+    def set(self, v):
+        return None
+
+
+class _NullHistogram:
+    name = "null"
+    labels = {}
+    count = 0
+    sum = 0.0
+
+    def record(self, value):
+        return None
+
+    def bucket_index(self, value):
+        return 0
+
+    def percentile(self, q):
+        return None
+
+    def snapshot(self):
+        return {"count": 0, "sum": 0.0, "buckets": {}, "overflow": 0,
+                "p50": None, "p99": None}
+
+
+class NullRegistry:
+    """No-op twin of `Registry`: identical interface, singleton no-op
+    metrics, constant-time everywhere. The uninstrumented baseline —
+    `ArenaEngine`'s default, and the comparator the bench overhead
+    gate measures the live registry against."""
+
+    enabled = False
+    _COUNTER = _NullCounter()
+    _GAUGE = _NullGauge()
+    _HISTOGRAM = _NullHistogram()
+
+    def counter(self, name, **labels):
+        return self._COUNTER
+
+    def gauge(self, name, **labels):
+        return self._GAUGE
+
+    def histogram(self, name, base=DEFAULT_LATENCY_BASE,
+                  num_buckets=DEFAULT_NUM_BUCKETS, **labels):
+        return self._HISTOGRAM
+
+    def counter_sum(self, name):
+        return 0
+
+    def render(self):
+        return ""
+
+    def dump(self):
+        return {"counters": {}, "gauges": {}, "histograms": {}}
+
+    def dump_json(self):
+        return "{}"

@@ -1,0 +1,172 @@
+"""Span tracing: monotonic-clock stage spans in a bounded ring buffer.
+
+Where `arena/obs/metrics.py` answers "how much / how fast overall",
+spans answer "where did THIS request's time go": every pipeline stage
+(enqueue wait, pack, CSR merge, compaction, staging, jit dispatch,
+apply) and every serving operation (view build, query, snapshot,
+restore) wraps itself in `tracer.span(name)` — a context manager that
+reads `time.perf_counter()` on enter and exit and records one
+fixed-size row into preallocated ring arrays.
+
+Honest-timing note: spans time HOST stages — work that is complete
+when `__exit__` runs (NumPy packing, lock waits, file IO, dispatch
+issue). They are NOT a device-time measurement: a span around an
+asynchronous jax dispatch measures dispatch issue cost, which is the
+host-side quantity the pipeline overlaps (the bench's wall-clock
+numbers, which DO include device time, keep their explicit
+`block_until_ready` discipline — the jaxlint `timing-without-block`
+rule polices that, and a corpus example shows the hand-rolled version
+of this pattern being flagged while this API is not: the clock reads
+live inside `_Span`, not interleaved with the caller's dispatches).
+
+The ring is bounded and overwrite-oldest: a long soak keeps the NEWEST
+`capacity` spans and counts what it dropped (`dropped` — exposed as
+the `trace_dropped` counter in dumps), so tracing can stay on in
+production without growing memory. Export is Chrome trace-event JSON
+(`chrome://tracing`, Perfetto): complete "X" events with microsecond
+timestamps, one row per span, thread id preserved.
+
+No jax imports (same rule as the metrics half).
+"""
+
+import json
+import threading
+import time
+
+
+class _Span:
+    """One live span: clock read on enter, row recorded on exit."""
+
+    __slots__ = ("_tracer", "_name", "_t0")
+
+    def __init__(self, tracer, name):
+        self._tracer = tracer
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        self._tracer.record_span(self._name, self._t0, t1 - self._t0)
+        return False
+
+
+class Tracer:
+    """Bounded ring buffer of completed spans.
+
+    `capacity` rows are preallocated (name slots + float start/duration
+    arrays + int thread ids); recording wraps around, overwriting the
+    oldest row and incrementing `dropped` — newest-wins, fixed memory.
+    All mutation happens under one small lock (a span record is a few
+    list/scalar stores; contention is negligible next to the stages
+    being traced).
+    """
+
+    def __init__(self, capacity=4096):
+        if capacity < 1:
+            raise ValueError(f"tracer capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._names = [None] * capacity
+        self._starts = [0.0] * capacity
+        self._durs = [0.0] * capacity
+        self._tids = [0] * capacity
+        self._n = 0  # total ever recorded
+        self.dropped = 0  # rows overwritten (n - capacity, floored at 0)
+        self._lock = threading.Lock()
+
+    @property
+    def recorded(self):
+        """Total spans ever recorded (kept + dropped)."""
+        return self._n
+
+    def span(self, name):
+        """Context manager timing one named host stage."""
+        return _Span(self, name)
+
+    def record_span(self, name, start, duration, tid=None):
+        """Record one completed span (the non-context-manager form, for
+        stages whose start/end cross function boundaries — e.g. the
+        pipeline's enqueue wait)."""
+        if tid is None:
+            tid = threading.get_ident()
+        with self._lock:
+            i = self._n % self.capacity
+            self._names[i] = name
+            self._starts[i] = start
+            self._durs[i] = duration
+            self._tids[i] = tid
+            self._n += 1
+            if self._n > self.capacity:
+                self.dropped += 1
+
+    def spans(self):
+        """Kept spans, oldest first: (name, start_s, duration_s, tid)."""
+        with self._lock:
+            n = min(self._n, self.capacity)
+            head = self._n % self.capacity
+            order = (
+                list(range(head, self.capacity)) + list(range(head))
+                if self._n > self.capacity
+                else list(range(n))
+            )
+            return [
+                (self._names[i], self._starts[i], self._durs[i], self._tids[i])
+                for i in order
+            ]
+
+    def export_chrome_trace(self):
+        """Chrome trace-event list: complete ("X") events, microsecond
+        units, loadable by chrome://tracing and Perfetto."""
+        return [
+            {
+                "name": name,
+                "ph": "X",
+                "ts": round(start * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": 0,
+                "tid": tid,
+            }
+            for name, start, dur, tid in self.spans()
+        ]
+
+    def export_chrome_trace_json(self):
+        return json.dumps({"traceEvents": self.export_chrome_trace()})
+
+
+class _NullSpan:
+    """Singleton no-op context manager (zero allocation per span)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+class NullTracer:
+    """No-op twin of `Tracer`: `span()` hands back one shared no-op
+    context manager, nothing is ever recorded or allocated."""
+
+    capacity = 0
+    dropped = 0
+    recorded = 0
+    _SPAN = _NullSpan()
+
+    def span(self, name):
+        return self._SPAN
+
+    def record_span(self, name, start, duration, tid=None):
+        return None
+
+    def spans(self):
+        return []
+
+    def export_chrome_trace(self):
+        return []
+
+    def export_chrome_trace_json(self):
+        return '{"traceEvents": []}'

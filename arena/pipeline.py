@@ -41,6 +41,15 @@ thread boundary the double buffer was built for:
   wait re-checks packer liveness, so a dead or never-started packer
   thread raises `PipelineError` instead of hanging the caller.
 
+- **Observability** (PR 6): the pipeline reads the engine's
+  `arena.obs` handle per event — `pipeline.enqueue_wait` /
+  `pipeline.pack` / `pipeline.dispatch` spans, an enqueue-wait
+  histogram, and policy-labeled dropped/spilled registry counters
+  (`arena_pipeline_dropped_batches_total{policy=...}` etc.) that
+  `ArenaServer.stats()` reports and that survive pipeline restarts.
+  The internal integer counters below remain the source of truth for
+  `pending()`; the registry is the reporting schema.
+
 On this image's single host core the two threads share one CPU, so the
 overlap cannot beat the synchronous path in wall clock (the bench
 reports what it measures, with `host_cores` in the line); the
@@ -113,6 +122,27 @@ class IngestPipeline:
 
     # --- accounting --------------------------------------------------
 
+    def _obs(self):
+        """The engine's observability handle, read PER EVENT so a
+        serving layer upgrading the engine's obs mid-life (set_obs)
+        is picked up without rewiring the pipeline."""
+        return self._eng.obs
+
+    def _count_dropped(self, batches, matches):
+        """Registry half of drop accounting: the internal ints above
+        stay the source of truth for pending() (they are read under
+        _cv as one consistent set), and every drop ALSO lands in the
+        registry as policy-labeled counters — the one schema
+        `ArenaServer.stats()` and the soak bench report from. Counts
+        survive pipeline restarts there, unlike these attributes."""
+        obs = self._obs()
+        obs.counter(
+            "arena_pipeline_dropped_batches_total", policy=self.policy
+        ).inc(batches)
+        obs.counter(
+            "arena_pipeline_dropped_matches_total", policy=self.policy
+        ).inc(matches)
+
     def pending(self):
         """Batches submitted but not yet dispatched (or dropped)."""
         with self._cv:
@@ -152,6 +182,7 @@ class IngestPipeline:
         caller dispatches ready work — backpressure can never deadlock
         against a packer waiting for a staging slot.
         """
+        wait_t0 = None
         while True:
             with self._cv:
                 if self._closed:
@@ -166,14 +197,24 @@ class IngestPipeline:
                     dw, _dl = self._raw.popleft()
                     self.dropped_batches += 1
                     self.dropped_matches += int(dw.shape[0])
+                    self._count_dropped(1, int(dw.shape[0]))
                     continue
                 self._check_packer_locked()
+            if wait_t0 is None:
+                wait_t0 = time.perf_counter()
             # Block policy, queue full: make progress instead of
             # spinning — dispatch one ready batch if there is one
             # (frees a staging slot, letting the packer advance).
             if not self._dispatch_one():
                 with self._cv:
                     self._cv.wait(_WAIT_S)
+        if wait_t0 is not None:
+            # Backpressure made this submit wait (dispatching ready
+            # work counts as waiting: the caller could not enqueue).
+            waited = time.perf_counter() - wait_t0
+            obs = self._obs()
+            obs.histogram("arena_pipeline_enqueue_wait_seconds").record(waited)
+            obs.tracer.record_span("pipeline.enqueue_wait", wait_t0, waited)
         # Overlap: opportunistically dispatch whatever the packer has
         # already staged while the caller is here anyway.
         while self._dispatch_one():
@@ -190,7 +231,8 @@ class IngestPipeline:
                 packed = self._ready.popleft()
             t0 = time.perf_counter()
             try:
-                self._eng._dispatch_packed(packed)
+                with self._obs().span("pipeline.dispatch"):
+                    self._eng._dispatch_packed(packed)
             finally:
                 self.dispatch_s += time.perf_counter() - t0
                 with self._cv:
@@ -239,11 +281,24 @@ class IngestPipeline:
                     self.spilled_batches += 1
                     self.spilled_matches += int(sw.shape[0])
                     spilled.append((sw, sl))
+                if spilled:
+                    obs = self._obs()
+                    obs.counter("arena_pipeline_spilled_batches_total").inc(
+                        len(spilled)
+                    )
+                    obs.counter("arena_pipeline_spilled_matches_total").inc(
+                        self.spilled_matches
+                    )
             elif not drain:
+                dropped_b = dropped_m = 0
                 while self._raw:
                     dw, _dl = self._raw.popleft()
                     self.dropped_batches += 1
                     self.dropped_matches += int(dw.shape[0])
+                    dropped_b += 1
+                    dropped_m += int(dw.shape[0])
+                if dropped_b:
+                    self._count_dropped(dropped_b, dropped_m)
             self._cv.notify_all()
         try:
             self.flush()
@@ -267,7 +322,8 @@ class IngestPipeline:
                 self._cv.notify_all()  # queue space for blocked submits
             try:
                 t0 = time.perf_counter()
-                packed = self._eng._pack_for_pipeline(w, l)
+                with self._obs().span("pipeline.pack"):
+                    packed = self._eng._pack_for_pipeline(w, l)
                 self.host_pack_s += time.perf_counter() - t0
             except BaseException as exc:  # noqa: BLE001 — must surface on the caller
                 with self._cv:
@@ -275,10 +331,13 @@ class IngestPipeline:
                     self._packing = False
                     # The failed batch and everything behind it is
                     # dropped; flush()/submit() re-raise on next call.
-                    self.dropped_batches += 1 + len(self._raw)
-                    self.dropped_matches += int(w.shape[0]) + sum(
+                    dropped_b = 1 + len(self._raw)
+                    dropped_m = int(w.shape[0]) + sum(
                         int(rw.shape[0]) for rw, _rl in self._raw
                     )
+                    self.dropped_batches += dropped_b
+                    self.dropped_matches += dropped_m
+                    self._count_dropped(dropped_b, dropped_m)
                     self._raw.clear()
                     self._cv.notify_all()
                 return

@@ -66,6 +66,7 @@ from arena import ratings as R
 from arena.analysis import sanitize
 from arena.engine import ArenaEngine
 from arena.ingest import MergeableCSR
+from arena.obs import Observability
 
 SNAPSHOT_MAGIC = b"ARENASNP"
 SNAPSHOT_VERSION = 1
@@ -359,6 +360,7 @@ class ArenaServer:
         bootstrap_rounds=32,
         bootstrap_seed=0,
         donation_sample_every=16,
+        obs=None,
         **engine_kwargs,
     ):
         if (engine is None) == (num_players is None):
@@ -367,9 +369,24 @@ class ArenaServer:
             raise ValueError(
                 f"max_staleness_matches must be >= 0, got {max_staleness_matches}"
             )
-        self.engine = engine if engine is not None else ArenaEngine(
-            num_players, **engine_kwargs
-        )
+        # A serving surface defaults to a LIVE observability instance —
+        # latency percentiles and drop counters are what a front door's
+        # load-shedding policy stands behind (ROADMAP item 1's
+        # telemetry prerequisite). An explicit `obs` wins everywhere; a
+        # handed-in engine keeps its own live obs; a handed-in
+        # null-instrumented engine is upgraded to the server's.
+        if obs is not None:
+            self.obs = obs
+        elif engine is not None and engine.obs.enabled:
+            self.obs = engine.obs
+        else:
+            self.obs = Observability()
+        if engine is not None:
+            if engine.obs is not self.obs:
+                engine.set_obs(self.obs)
+            self.engine = engine
+        else:
+            self.engine = ArenaEngine(num_players, obs=self.obs, **engine_kwargs)
         self.max_staleness_matches = max_staleness_matches
         self.bootstrap_rounds = bootstrap_rounds
         self.bootstrap_seed = bootstrap_seed
@@ -381,21 +398,36 @@ class ArenaServer:
         self._seq = 0
         self._restoring = False
         self._intervals = None  # (lo, hi) ndarrays from the last bootstrap
-        self.queries = 0
-        self.view_refreshes = 0
-        self.stale_serves = 0
-        self.snapshots = 0
-        self.restores = 0
+        # Serving counters live in the registry — ONE schema shared by
+        # stats(), the Prometheus render(), and the soak bench line.
+        reg = self.obs
+        self._c_queries = reg.counter("arena_queries_total")
+        self._c_view_refreshes = reg.counter("arena_view_refreshes_total")
+        self._c_stale_serves = reg.counter("arena_stale_serves_total")
+        self._c_snapshots = reg.counter("arena_snapshots_total")
+        self._c_restores = reg.counter("arena_restores_total")
+        self._c_recompiles = reg.counter("arena_recompile_events_total")
+        self._c_donation_calls = reg.counter("arena_donation_calls_total")
+        self._c_donation_sampled = reg.counter("arena_donation_sampled_total")
+        self._c_donation_skipped = reg.counter("arena_donation_skipped_total")
+        self._h_query_latency = reg.histogram("arena_query_latency_seconds")
+        self._h_staleness = reg.histogram(
+            "arena_query_staleness_matches", base=1.0
+        )
         self._wire_sanitizers()
 
     # --- production-mode sanitizers ----------------------------------
 
     def _wire_sanitizers(self):
-        """Count-mode sentinel over the engine's update cache + sampled
-        count-mode donation guard around the donating update. Serving
-        default posture: violations become `stats()` counters."""
+        """Count-mode sentinel over the engine's update AND bootstrap
+        caches + sampled count-mode donation guard around the donating
+        update. Serving default posture: violations become `stats()`
+        counters. Re-wired on restore (fresh engine), so the delta
+        baselines reset alongside."""
         self._sentinel = sanitize.RecompileSentinel(
-            mode="count", update=self.engine.num_compiles
+            mode="count",
+            update=self.engine.num_compiles,
+            bootstrap=self.engine.num_bootstrap_compiles,
         )
         self.engine._update = self._donation_guard = sanitize.donation_guard(
             self.engine._update,
@@ -403,52 +435,104 @@ class ArenaServer:
             mode="count",
             sample_every=self._donation_sample_every,
         )
+        # Deltas already absorbed into the registry counters from the
+        # PREVIOUS sentinel/guard (zero on first wire).
+        self._absorbed = {"recompile": 0, "calls": 0, "sampled": 0,
+                          "skipped": 0}
+
+    def _observe_sanitizers(self):
+        """Absorb the sentinel/guard counters into the registry — the
+        single schema every exposition path (stats(), render(), the
+        soak line) reads. Delta-based so re-reads never double-count,
+        and a re-wire (restore) restarts cleanly at zero."""
+        with self._lock:
+            self._sentinel.observe()
+            for key, counter, now in (
+                ("recompile", self._c_recompiles,
+                 self._sentinel.recompile_events),
+                ("calls", self._c_donation_calls, self._donation_guard.calls),
+                ("sampled", self._c_donation_sampled,
+                 self._donation_guard.sampled),
+                ("skipped", self._c_donation_skipped,
+                 self._donation_guard.donation_skipped),
+            ):
+                delta = now - self._absorbed[key]
+                if delta:
+                    counter.inc(delta)
+                    self._absorbed[key] = now
 
     def stats(self):
-        """Serving + sanitizer counters (all monotone)."""
-        self._sentinel.observe()
+        """Serving + sanitizer + pipeline counters (all monotone), plus
+        the full one-JSON-line observability dump under "obs". Every
+        number is read from the metrics registry — the same schema
+        `render()` exposes and the soak bench reports."""
+        self._observe_sanitizers()
+        reg = self.obs.registry
+        pipe = self.engine._pipeline
         return {
-            "queries": self.queries,
-            "view_refreshes": self.view_refreshes,
-            "stale_serves": self.stale_serves,
-            "snapshots": self.snapshots,
-            "restores": self.restores,
+            "queries": self._c_queries.value,
+            "view_refreshes": self._c_view_refreshes.value,
+            "stale_serves": self._c_stale_serves.value,
+            "snapshots": self._c_snapshots.value,
+            "restores": self._c_restores.value,
             "matches_ingested": self.engine.matches_ingested,
             "matches_applied": self.engine.matches_applied,
-            "recompile_events": self._sentinel.recompile_events,
-            "donation_calls": self._donation_guard.calls,
-            "donation_sampled": self._donation_guard.sampled,
-            "donation_skipped": self._donation_guard.donation_skipped,
+            "recompile_events": self._c_recompiles.value,
+            "donation_calls": self._c_donation_calls.value,
+            "donation_sampled": self._c_donation_sampled.value,
+            "donation_skipped": self._c_donation_skipped.value,
+            # Per-stage drop accounting (policy-labeled counters summed
+            # here; the labeled split is in the "obs" dump). Registry
+            # counters survive pipeline restarts, so these are stream
+            # totals, not last-pipeline totals.
+            "pipeline": {
+                "pending": pipe.pending() if pipe is not None else 0,
+                "dropped_batches": reg.counter_sum(
+                    "arena_pipeline_dropped_batches_total"
+                ),
+                "dropped_matches": reg.counter_sum(
+                    "arena_pipeline_dropped_matches_total"
+                ),
+                "spilled_batches": reg.counter_sum(
+                    "arena_pipeline_spilled_batches_total"
+                ),
+                "spilled_matches": reg.counter_sum(
+                    "arena_pipeline_spilled_matches_total"
+                ),
+            },
+            "obs": self.obs.dump(),
         }
 
     # --- views and staleness -----------------------------------------
 
     def refresh_view(self):
         """Build a fresh immutable view from the live engine."""
-        with self._lock:
+        with self.obs.span("serve.view_build"), self._lock:
             ratings, watermark = self.engine.ratings_snapshot()
             store = self.engine._store.clone()
             lo, hi = self._intervals if self._intervals is not None else (None, None)
             self._seq += 1
             self._view = ServingView(ratings, watermark, store, lo, hi, self._seq)
-            self.view_refreshes += 1
-            self._sentinel.observe()
+            self._c_view_refreshes.inc()
+            self._observe_sanitizers()
             return self._view
 
     def refresh_intervals(self, num_rounds=None, seed=None, alpha=0.05,
-                          batch_size=8192):
+                          batch_size=8192, min_epoch_batches=None):
         """Recompute bootstrap (lo, hi) rating intervals and refresh
         the view so queries serve them. Deterministic under a fixed
-        seed (defaults to the server's `bootstrap_seed`). Costs
-        num_rounds resampled epochs of device time plus one compile
-        per new epoch shape — call it at a fixed cadence, not per
-        query (the zero-steady-state-compile posture of the serve
-        bench keeps it out of the measured window)."""
+        seed (defaults to the server's `bootstrap_seed`). The epoch
+        batch count is pow2-padded and the resampler jit is cached per
+        engine (`ArenaEngine.bootstrap_ratings`), so refreshing at a
+        fixed cadence as history grows compiles O(log N) times total —
+        `min_epoch_batches` pins the padding to a planned horizon for
+        a strictly compile-free window (the soak bench's posture)."""
         rounds = self.bootstrap_rounds if num_rounds is None else num_rounds
         samples = self.engine.bootstrap_ratings(
             num_rounds=rounds,
             seed=self.bootstrap_seed if seed is None else seed,
             batch_size=batch_size,
+            min_batches=min_epoch_batches,
         )
         lo, hi = R.bootstrap_intervals(samples, alpha=alpha)
         with self._lock:
@@ -465,7 +549,7 @@ class ArenaServer:
         with the explicit stale marker. Returns (view, stale)."""
         view = self._view
         if self._restoring and view is not None:
-            self.stale_serves += 1
+            self._c_stale_serves.inc()
             return view, True
         if view is None or self._staleness(view) > self.max_staleness_matches:
             view = self.refresh_view()
@@ -473,7 +557,7 @@ class ArenaServer:
         if stale:
             # Refresh could not catch up (async pipeline deeper than
             # the bound): served honestly, marked explicitly.
-            self.stale_serves += 1
+            self._c_stale_serves.inc()
         return view, stale
 
     # --- the batched query API ---------------------------------------
@@ -488,8 +572,9 @@ class ArenaServer:
         (nothing is served). The response carries the view's
         watermark, its staleness at serve time, and the stale flag.
         """
+        t0 = time.perf_counter()
         view, stale = self._serve_view()
-        self.queries += 1
+        self._c_queries.inc()
         num_players = view.ratings.size
         out = {
             "watermark": view.watermark,
@@ -537,6 +622,14 @@ class ArenaServer:
                     ),
                 })
             out["pairs"] = rows
+        # Latency + staleness distributions: the p50/p99 substrate the
+        # soak bench (and any future network tier) reports. Host-side
+        # work only between the clock reads — every value served came
+        # from the prebuilt host view, nothing here awaits a device.
+        latency = time.perf_counter() - t0
+        self._h_query_latency.record(latency)
+        self._h_staleness.record(out["staleness"])
+        self.obs.tracer.record_span("serve.query", t0, latency)
         return out
 
     def _player_row(self, view, p, rank=None):
@@ -565,7 +658,7 @@ class ArenaServer:
         lazily on the next ingest_async). Either way ratings and
         match store agree exactly at write time.
         """
-        with self._lock:
+        with self.obs.span("serve.snapshot"), self._lock:
             eng = self.engine
             if spill:
                 queue = eng.shutdown(spill=True)
@@ -600,7 +693,7 @@ class ArenaServer:
                 ratings=ratings,
                 queue=queue,
             )
-            self.snapshots += 1
+            self._c_snapshots.inc()
             return manifest
 
     def restore(self, path):
@@ -617,34 +710,36 @@ class ArenaServer:
         """
         self._restoring = True
         try:
-            manifest, arrays = read_snapshot(path)
-            store = self._assemble_store(manifest, arrays)
-            eng = ArenaEngine(
-                manifest["num_players"],
-                k=manifest["k"],
-                scale=manifest["scale"],
-                base=manifest["base"],
-                min_bucket=manifest["min_bucket"],
-            )
-            eng.adopt_state(arrays["ratings"], store)
-            queue = _split_queue(arrays)
-            with self._lock:
-                old = self.engine
-                old.shutdown()
-                self.engine = eng
-                self._wire_sanitizers()
-                # Resume mid-stream: the spilled queue replays through
-                # the normal ingest path, in submission order.
-                for w, l in queue:
-                    eng.ingest(w, l)
-                self.restores += 1
+            with self.obs.span("serve.restore"):
+                manifest, arrays = read_snapshot(path)
+                store = self._assemble_store(manifest, arrays)
+                eng = ArenaEngine(
+                    manifest["num_players"],
+                    k=manifest["k"],
+                    scale=manifest["scale"],
+                    base=manifest["base"],
+                    min_bucket=manifest["min_bucket"],
+                    obs=self.obs,
+                )
+                eng.adopt_state(arrays["ratings"], store)
+                queue = _split_queue(arrays)
+                with self._lock:
+                    old = self.engine
+                    old.shutdown()
+                    self.engine = eng
+                    self._wire_sanitizers()
+                    # Resume mid-stream: the spilled queue replays
+                    # through the normal ingest path, in submission
+                    # order.
+                    for w, l in queue:
+                        eng.ingest(w, l)
+                    self._c_restores.inc()
         finally:
             self._restoring = False
         self.refresh_view()
         return manifest
 
-    @staticmethod
-    def _assemble_store(manifest, arrays):
+    def _assemble_store(self, manifest, arrays):
         """`MergeableCSR.from_state` with its ValueErrors upgraded to
         the snapshot-reject contract (distinct error, nothing
         installed). The delta tail is restored AS RUNS — dropping it
@@ -664,7 +759,9 @@ class ArenaServer:
             "losers": arrays["losers"],
         }
         try:
-            return MergeableCSR.from_state(manifest["num_players"], state)
+            return MergeableCSR.from_state(
+                manifest["num_players"], state, obs=self.obs
+            )
         except ValueError as exc:
             raise SnapshotError(
                 f"snapshot arrays are internally inconsistent: {exc}"

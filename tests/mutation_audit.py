@@ -238,10 +238,10 @@ MUTATIONS = (
     (
         "ingest-drops-the-delta-tail",
         "arena/ingest.py",
-        "        self._keys, self._pos = _gallop_merge(\n"
-        "            self._keys, self._pos, tail_k[order], tail_p[order]\n"
-        "        )",
-        "        self._keys, self._pos = self._keys, self._pos",
+        "            self._keys, self._pos = _gallop_merge(\n"
+        "                self._keys, self._pos, tail_k[order], tail_p[order]\n"
+        "            )",
+        "            self._keys, self._pos = self._keys, self._pos",
         "compaction must MERGE the delta tail into the main runs, never "
         "silently discard it — killed by "
         "test_galloping_merge_preserves_every_entry (and every ingest "
@@ -348,6 +348,49 @@ MUTATIONS = (
         "the distinct SnapshotError naming expected vs found, never "
         "restore a format it cannot be sure it parses correctly — killed by "
         "test_restore_rejects_mismatched_manifest_version",
+    ),
+    (
+        "obs-histogram-wrong-bucket",
+        "arena/obs/metrics.py",
+        '        return int(np.searchsorted(self.bounds, value, side="left"))',
+        '        return int(np.searchsorted(self.bounds, value, side="right"))',
+        "the log2 histogram must place a value exactly ON a bucket's upper "
+        "bound INTO that bucket (le semantics); side=\"right\" shifts every "
+        "boundary value one bucket up, silently skewing every p50/p99 the "
+        "system reports — killed by "
+        "test_histogram_bucket_boundary_values_land_exactly",
+    ),
+    (
+        "serving-stats-drops-sentinel-counters",
+        "arena/serving.py",
+        "        self._observe_sanitizers()\n        reg = self.obs.registry",
+        "        pass\n        reg = self.obs.registry",
+        "stats() must absorb the sentinel/donation-guard counters into the "
+        "registry before reporting; dropping the absorption makes "
+        "recompile_events read 0 while the engine recompiles — the exact "
+        "silent rot the soak gate stands on — killed by "
+        "test_stats_reports_absorbed_sentinel_counters_from_registry",
+    ),
+    (
+        "soak-gate-skipped",
+        "arena/bench_arena.py",
+        "    if not max_diff < tol:\n"
+        "        raise EquivalenceError(max_diff, tol)\n"
+        "    if torn or not max_mass_dev[0] < tol:\n"
+        "        raise EquivalenceError(float(\"inf\"), tol)\n"
+        "    if soak_recompiles != 0:",
+        "    if False:\n"
+        "        raise EquivalenceError(max_diff, tol)\n"
+        "    if False:\n"
+        "        raise EquivalenceError(float(\"inf\"), tol)\n"
+        "    if False:",
+        "the soak bench's HARD gates (sync-replay equivalence, torn views, "
+        "zero recompile events) must all hold before any p99 is reported; "
+        "with the whole block skipped a diverging or recompiling soak would "
+        "still exit rc 0 — killed by test_soak_bench_gate_is_hard (tol 0 "
+        "must exit rc 2, never rc 0); the full block is covered so no "
+        "single surviving condition can mask another (the lesson the "
+        "pipeline gate mutant already taught)",
     ),
     (
         "lint-donation-poisoning-dropped",

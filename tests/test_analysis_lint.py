@@ -33,6 +33,7 @@ CORPUS_EXPECTED = {
     "bad_nonstatic_shape.py": {"nonstatic-shape-arg"},
     "bad_use_after_donate.py": {"use-after-donate"},
     "bad_timing.py": {"timing-without-block"},
+    "bad_timing_span.py": {"timing-without-block"},
     "bad_jnp_host.py": {"jnp-on-host-path"},
     "bad_sharding_spec.py": {"sharding-spec-arity"},
 }
@@ -76,17 +77,43 @@ def test_host_sync_rule_names_each_call_form():
 
 def test_default_targets_cover_the_ingest_and_pipeline_modules():
     """The seven rules gate every NEW hot path: arena/ingest.py,
-    arena/pipeline.py and arena/serving.py must be inside the
-    default-target walk (so `python -m arena.analysis` and the
-    clean-tree test both lint them) and must themselves lint clean."""
+    arena/pipeline.py, arena/serving.py and the arena/obs/ package
+    must be inside the default-target walk (so `python -m
+    arena.analysis` and the clean-tree test both lint them) and must
+    themselves lint clean."""
     walked = {
         str(f) for f in jaxlint.iter_python_files(jaxlint.default_targets())
     }
-    for mod in ("ingest.py", "pipeline.py", "serving.py"):
+    for mod in (
+        "ingest.py", "pipeline.py", "serving.py",
+        "obs/__init__.py", "obs/metrics.py", "obs/tracing.py",
+    ):
         path = str(REPO / "arena" / mod)
         assert path in walked, f"default targets no longer cover arena/{mod}"
         findings = jaxlint.lint_paths([path])
         assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_obs_span_api_does_not_trip_the_timing_rule():
+    """The corpus carries the DIY span (bad_timing_span.py: inline
+    clock reads around an async dispatch — flagged); the real tracing
+    API keeps its clock reads inside `_Span.__enter__`/`__exit__`, so
+    an instrumented dispatch lints clean — spans time host stages, not
+    unblocked device work, and the linter agrees."""
+    diy = (CORPUS / "bad_timing_span.py").read_text()
+    assert {f.rule for f in jaxlint.lint_source(diy, "diy.py")} == {
+        "timing-without-block"
+    }
+    instrumented = (
+        "import jax.numpy as jnp\n"
+        "from arena.obs import Observability\n"
+        "obs = Observability()\n"
+        "def dispatch_epoch(x):\n"
+        "    with obs.span('engine.jit_dispatch'):\n"
+        "        y = jnp.dot(x, x)\n"
+        "    return y\n"
+    )
+    assert jaxlint.lint_source(instrumented, "ok.py") == []
 
 
 def test_sharding_spec_rule_flags_both_failure_modes():

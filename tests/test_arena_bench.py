@@ -120,6 +120,12 @@ def test_ingest_bench_smoke_contract():
     assert result["bt"]["chunked_peak_entries"] < result["bt"]["single_bucket_entries"]
     assert result["max_rating_diff"] < 0.5
     assert result["params"]["delta_matches"] == 2000
+    # The instrumentation-overhead gate ran (rc 0 means it passed) and
+    # the live registry actually recorded every instrumented build:
+    # one whole-set (base + delta) live build per repeat.
+    assert result["obs"]["tolerance"] > 0
+    assert result["obs"]["csr_merges_counted"] == 22000 * 2
+    assert result["obs"]["spans_recorded"] > 0
 
 
 def test_ingest_bench_equivalence_gate_extends_to_incremental_path():
@@ -184,6 +190,10 @@ def test_pipeline_bench_smoke_contract():
     assert result["pipeline"]["dispatch_s"] > 0
     assert result["params"]["host_cores"] >= 1
     assert result["params"]["policy"] == "block"
+    # The instrumented twin streamed the same batches within budget
+    # (rc 0 means the overhead hard gate passed) and recorded spans.
+    assert result["obs"]["null_s"] > 0 and result["obs"]["live_s"] > 0
+    assert result["obs"]["spans_recorded"] > 0
 
 
 def test_pipeline_bench_equivalence_gate_extends_to_async_path():
@@ -292,6 +302,81 @@ def test_serve_bench_full_size_round_trips_100k_bit_exact():
     assert result["max_resume_diff"] == 0.0
     assert result["serve"]["steady_state_new_compiles"] == 0
     assert result["value"] > 0
+
+
+SOAK_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "soak",
+    "ARENA_BENCH_MATCHES": "20000",
+    "ARENA_BENCH_DELTA": "2000",
+    "ARENA_BENCH_SOAK_BATCHES": "8",
+    "ARENA_BENCH_PLAYERS": "64",
+    "ARENA_BENCH_BATCH": "2048",
+    "ARENA_BENCH_BOOTSTRAP_ROUNDS": "4",
+}
+
+
+def test_soak_bench_smoke_contract():
+    """ARENA_BENCH_MODE=soak through the real entrypoint: one JSON
+    line, rc 0, the arena_soak metric with p50/p99 query latency,
+    ingest throughput, queue-depth and staleness distributions,
+    interval refreshes AND snapshots inside the measured window, ZERO
+    recompile events across the whole mixed workload (the hard gate),
+    and the final ratings bit-exact to the sync replay."""
+    result = run_bench(SOAK_SMOKE_ENV)
+    assert result["metric"] == "arena_soak"
+    assert result["unit"] == "p99_query_latency_ms"
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["value"] > 0
+    soak = result["soak"]
+    assert soak["queries"] > 0
+    assert soak["query_latency_ms"]["p50"] > 0
+    assert soak["query_latency_ms"]["p99"] >= soak["query_latency_ms"]["p50"]
+    assert soak["stream_matches_per_s"] > 0
+    assert soak["queue_depth"]["count"] == 8  # one sample per batch
+    assert soak["staleness_matches"]["count"] == soak["queries"] + 1
+    assert soak["interval_refreshes"] == 2 and soak["snapshots"] == 2
+    # The soak's reason to exist: the production counters stayed flat.
+    assert soak["recompile_events"] == 0
+    assert soak["donation_skipped"] == 0
+    assert soak["dropped_batches"] == 0
+    assert soak["trace_spans_recorded"] > 0
+    assert soak["max_view_mass_dev"] < 0.5
+    assert result["params"]["max_staleness_matches"] == 2000
+
+
+def test_soak_bench_gate_is_hard():
+    """The soak gate covers equivalence AND the recompile counter:
+    with the tolerance forced to 0 even a bit-exact run trips it (no
+    diff is < 0) — the distinct equivalence-failure line and rc 2, so
+    a silently skipped soak gate is loudly visible (the mutation audit
+    carries exactly that mutant; this is its named kill)."""
+    result = run_bench(
+        {**SOAK_SMOKE_ENV, "ARENA_BENCH_TOL": "0"}, expect_rc=2
+    )
+    assert result["metric"] == "arena_bench_equivalence_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "p99_query_latency_ms"
+    assert result["tolerance"] == 0.0
+    assert "exceeds tolerance" in result["error"]
+    assert "soak" not in result
+
+
+@pytest.mark.slow
+def test_soak_bench_full_size_stays_compile_free():
+    """The acceptance run: the full-size mixed workload (100k base,
+    16 streamed 10k batches with periodic snapshots and interval
+    refreshes under concurrent queries) holds recompile_events == 0
+    and sync-replay equivalence end to end."""
+    result = run_bench({"ARENA_BENCH_MODE": "soak"}, timeout=600)
+    assert result["metric"] == "arena_soak"
+    assert result["params"]["base_matches"] == 100_000
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["soak"]["recompile_events"] == 0
+    assert result["soak"]["queries"] > 0
+    assert result["soak"]["snapshots"] == 4
+    assert result["soak"]["interval_refreshes"] == 4
 
 
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():

@@ -57,9 +57,10 @@ def test_mutations_cover_every_policed_surface():
     whose corpus test is itself a policed property since PR 2), the
     incremental ingest layer (equivalence/threshold/peak-bucket, PR 3),
     since PR 4 the overlapped pipeline (packer liveness) plus the
-    arena bench's async equivalence gate, and since PR 5 the serving
+    arena bench's async equivalence gate, since PR 5 the serving
     layer (silent-partial-restore, staleness policy, snapshot version
-    gate)."""
+    gate), and since PR 6 the observability layer (histogram bucket
+    semantics, stats() sentinel absorption, the soak hard gate)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -69,6 +70,7 @@ def test_mutations_cover_every_policed_surface():
         "arena/pipeline.py",
         "arena/serving.py",
         "arena/bench_arena.py",
+        "arena/obs/metrics.py",
     }
 
 
@@ -98,6 +100,7 @@ def _fake_sources_only(dest):
         "arena/pipeline.py",
         "arena/serving.py",
         "arena/bench_arena.py",
+        "arena/obs/metrics.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)

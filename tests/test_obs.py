@@ -1,0 +1,404 @@
+"""Observability-layer contracts (arena/obs/ + its wiring).
+
+The load-bearing properties:
+
+- EXACTNESS under concurrency: counter increments and histogram
+  records from N threads sum exactly (per-metric locks — a lost update
+  here silently corrupts every p99 the system reports);
+- bucket-boundary semantics: log2 histograms place a value exactly ON
+  a bucket's upper bound INTO that bucket (`le` semantics) — the
+  mutation audit carries a wrong-bucket mutant;
+  test_histogram_bucket_boundary_values_land_exactly is its named kill;
+- the trace ring is bounded newest-wins: overflow keeps the newest
+  spans and counts the drops (`trace_dropped`), so tracing can stay on
+  in production with fixed memory;
+- the Null twins are true no-ops with the identical interface (the
+  uninstrumented baseline the bench overhead gate compares against);
+- the wiring: a live-instrumented engine/pipeline records the stage
+  spans and policy-labeled drop counters, `ArenaServer.stats()` folds
+  everything (sanitizer counters included — the audit carries a
+  stats-drops-sentinel-counters mutant killed by
+  test_stats_reports_absorbed_sentinel_counters_from_registry) into
+  one JSON-serializable dict, and `render()` is Prometheus-shaped.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from arena import obs as obs_pkg
+from arena.engine import ArenaEngine
+from arena.obs.metrics import Histogram, NullRegistry, Registry
+from arena.obs.tracing import NullTracer, Tracer
+from arena.serving import ArenaServer
+
+P = 40
+
+
+def make_matches(n, num_players=P, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, num_players, n).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, num_players - 1, n)) % num_players).astype(
+        np.int32
+    )
+    return a, b
+
+
+# --- exactness under concurrency -------------------------------------------
+
+
+def test_concurrent_counter_and_histogram_sums_are_exact():
+    """N threads hammering one counter and one histogram lose NOTHING:
+    the totals equal the arithmetic sum of every increment/record."""
+    reg = Registry()
+    counter = reg.counter("arena_test_total")
+    hist = reg.histogram("arena_test_seconds")
+    threads, per_thread = 8, 2000
+
+    def worker(tid):
+        for i in range(per_thread):
+            counter.inc()
+            hist.record(1e-6 * (1 + (i + tid) % 7))
+
+    workers = [
+        threading.Thread(target=worker, args=(t,)) for t in range(threads)
+    ]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=60.0)
+    assert counter.value == threads * per_thread
+    assert hist.count == threads * per_thread
+    # Every record also landed in exactly one bucket.
+    assert int(hist._counts.sum()) == threads * per_thread
+
+
+def test_labeled_counters_are_distinct_and_summable():
+    reg = Registry()
+    reg.counter("arena_drops_total", policy="block").inc(3)
+    reg.counter("arena_drops_total", policy="drop-oldest").inc(4)
+    assert reg.counter("arena_drops_total", policy="block").value == 3
+    assert reg.counter_sum("arena_drops_total") == 7
+    assert reg.counter_sum("never_incremented_total") == 0
+
+
+# --- histogram bucket semantics --------------------------------------------
+
+
+def test_histogram_bucket_boundary_values_land_exactly():
+    """`le` semantics: a value exactly ON an upper bound belongs to
+    THAT bucket; epsilon above it belongs to the next. The mutation
+    audit carries a wrong-bucket mutant; this is its named kill."""
+    h = Histogram("t", {}, base=1e-3, num_buckets=8)
+    # Bounds are 1e-3 * 2**i. Exactly on bound i -> bucket i.
+    for i in range(8):
+        assert h.bucket_index(1e-3 * 2.0**i) == i, f"bound {i}"
+    # Epsilon above a bound -> the NEXT bucket.
+    assert h.bucket_index(1e-3 * 1.0000001) == 1
+    assert h.bucket_index(1e-3 * 2.0000001) == 2
+    # At or below base (incl. zero/negative) -> bucket 0.
+    assert h.bucket_index(0.0) == 0
+    assert h.bucket_index(-1.0) == 0
+    assert h.bucket_index(0.5e-3) == 0
+    # Past the last bound -> the overflow slot.
+    assert h.bucket_index(1e-3 * 2.0**7 + 1.0) == 8
+    h.record(1e-3 * 2.0**3)
+    assert int(h._counts[3]) == 1 and h.count == 1
+
+
+def test_histogram_percentiles_are_conservative_bucket_bounds():
+    h = Histogram("t", {}, base=1.0, num_buckets=6)
+    assert h.percentile(0.5) is None  # empty: no fabricated number
+    for v in [1, 1, 1, 1, 1, 1, 1, 1, 1, 30]:  # 90% in bucket 0, one in [16,32]
+        h.record(v)
+    assert h.percentile(0.5) == 1.0
+    assert h.percentile(0.99) == 32.0  # upper bound of 30's bucket
+    h.record(1e9)  # overflow: the honest answer is "past the range"
+    assert h.percentile(1.0) == float("inf")
+
+
+def test_histogram_rejects_degenerate_shape():
+    with pytest.raises(ValueError, match="base > 0"):
+        Histogram("t", {}, base=0.0)
+    with pytest.raises(ValueError, match="base > 0"):
+        Histogram("t", {}, base=1.0, num_buckets=0)
+
+
+# --- trace ring ------------------------------------------------------------
+
+
+def test_trace_ring_overflow_keeps_newest_and_counts_drops():
+    tr = Tracer(capacity=8)
+    for i in range(20):
+        tr.record_span(f"s{i}", float(i), 0.5)
+    assert tr.dropped == 12
+    assert tr.recorded == 20
+    kept = [name for name, _t0, _dur, _tid in tr.spans()]
+    assert kept == [f"s{i}" for i in range(12, 20)]  # newest 8, in order
+
+
+def test_span_context_manager_records_duration_and_thread():
+    tr = Tracer(capacity=8)
+    with tr.span("work"):
+        pass
+    [(name, start, dur, tid)] = tr.spans()
+    assert name == "work" and dur >= 0.0 and start > 0.0
+    assert tid == threading.get_ident()
+
+
+def test_chrome_trace_export_shape():
+    tr = Tracer(capacity=4)
+    with tr.span("stage"):
+        pass
+    events = tr.export_chrome_trace()
+    assert len(events) == 1
+    ev = events[0]
+    assert ev["ph"] == "X" and ev["name"] == "stage"
+    assert ev["ts"] >= 0 and ev["dur"] >= 0 and "tid" in ev
+    doc = json.loads(tr.export_chrome_trace_json())
+    assert doc["traceEvents"] == events
+    with pytest.raises(ValueError, match="capacity"):
+        Tracer(capacity=0)
+
+
+# --- the Null twins --------------------------------------------------------
+
+
+def test_null_registry_and_tracer_are_true_noops():
+    reg = NullRegistry()
+    c = reg.counter("x", policy="p")
+    c.inc(100)
+    assert c.value == 0
+    h = reg.histogram("y")
+    h.record(1.0)
+    assert h.count == 0 and h.percentile(0.5) is None
+    reg.gauge("z").set(5.0)
+    assert reg.render() == "" and reg.counter_sum("x") == 0
+    assert reg.dump() == {"counters": {}, "gauges": {}, "histograms": {}}
+    tr = NullTracer()
+    with tr.span("a"):
+        pass
+    tr.record_span("b", 0.0, 1.0)
+    assert tr.spans() == [] and tr.dropped == 0 and tr.recorded == 0
+    assert not obs_pkg.NULL.enabled and obs_pkg.Observability().enabled
+
+
+# --- exposition ------------------------------------------------------------
+
+
+def test_render_is_prometheus_shaped():
+    o = obs_pkg.Observability()
+    o.counter("arena_q_total", policy="block").inc(2)
+    o.histogram("arena_lat_seconds", base=1e-3, num_buckets=4).record(1e-3)
+    text = o.render()
+    assert "# TYPE arena_q_total counter" in text
+    assert 'arena_q_total{policy="block"} 2' in text
+    assert "# TYPE arena_lat_seconds histogram" in text
+    assert 'arena_lat_seconds_bucket{le="0.001"} 1' in text
+    assert 'arena_lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "arena_lat_seconds_count 1" in text
+    # Cumulative buckets: every later bound carries the earlier count.
+    assert 'arena_lat_seconds_bucket{le="0.002"} 1' in text
+
+
+def test_dump_is_one_json_line():
+    o = obs_pkg.Observability()
+    o.counter("a_total").inc()
+    o.histogram("b_seconds").record(0.5)
+    with o.span("s"):
+        pass
+    line = json.dumps(o.dump())
+    doc = json.loads(line)
+    assert doc["counters"]["a_total"] == 1
+    assert doc["histograms"]["b_seconds"]["count"] == 1
+    assert doc["trace"]["spans_recorded"] == 1
+
+
+# --- wiring: engine / pipeline / serving -----------------------------------
+
+
+def test_live_engine_records_stage_spans_and_counters():
+    """An engine handed a live Observability traces the whole sync
+    path: csr merge, staging, jit dispatch, apply — and the ingest
+    counters move. The default engine (NULL) records nothing."""
+    o = obs_pkg.Observability()
+    eng = ArenaEngine(P, obs=o)
+    w, l = make_matches(300, seed=1)
+    eng.ingest(w, l)
+    names = {name for name, *_ in o.tracer.spans()}
+    assert {"ingest.csr_merge", "ingest.staging", "engine.jit_dispatch",
+            "engine.apply"} <= names
+    assert o.registry.counter_sum("arena_ingest_matches_total") == 300
+    plain = ArenaEngine(P)
+    plain.ingest(w, l)
+    assert plain.obs is obs_pkg.NULL
+    assert plain.obs.tracer.spans() == []
+
+
+def test_pipeline_drop_counters_land_in_registry_policy_labeled():
+    """The drop-oldest shed shows up as policy-labeled registry
+    counters (the one schema stats() reports from), alongside the
+    pipeline's own attributes."""
+    o = obs_pkg.Observability()
+    eng = ArenaEngine(P, obs=o)
+    pipe = eng.start_pipeline(capacity=2, policy="drop-oldest")
+    w, l = make_matches(100, seed=2)
+    batches = [(w[i * 20:(i + 1) * 20], l[i * 20:(i + 1) * 20]) for i in range(5)]
+    with eng._store._lock:  # stall the packer inside its first merge
+        eng.ingest_async(*batches[0])
+        deadline = [0]
+        while not pipe._packing and deadline[0] < 2000:
+            deadline[0] += 1
+            threading.Event().wait(0.005)
+        assert pipe._packing
+        for batch in batches[1:]:
+            eng.ingest_async(*batch)  # capacity 2: two oldest raw drop
+    eng.flush()
+    assert pipe.dropped_batches == 2
+    c = o.registry.counter("arena_pipeline_dropped_batches_total",
+                           policy="drop-oldest")
+    assert c.value == 2
+    assert o.registry.counter(
+        "arena_pipeline_dropped_matches_total", policy="drop-oldest"
+    ).value == 40
+    assert o.registry.counter_sum("arena_pipeline_dropped_batches_total") == 2
+    assert {"pipeline.pack", "pipeline.dispatch"} <= {
+        name for name, *_ in o.tracer.spans()
+    }
+    eng.shutdown()
+
+
+def test_stats_reports_pipeline_drops_and_spills_one_schema():
+    """ArenaServer.stats()["pipeline"] carries drop AND spill counts
+    from the registry — one place, one schema — and survives a
+    pipeline restart (registry counters are stream totals)."""
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    eng = srv.engine
+    w, l = make_matches(60, seed=3)
+    eng.ingest_async(w[:30], l[:30])
+    eng.flush()
+    spilled = eng.shutdown(spill=True)
+    assert spilled == []  # drained: nothing raw to spill
+    eng.ingest_async(w[30:], l[30:])  # fresh pipeline starts lazily
+    eng.flush()
+    stats = srv.stats()
+    assert stats["pipeline"]["pending"] == 0
+    assert stats["pipeline"]["dropped_batches"] == 0
+    assert stats["pipeline"]["spilled_batches"] == 0
+    assert stats["matches_ingested"] == 60
+    eng.shutdown()
+
+
+def test_stats_reports_absorbed_sentinel_counters_from_registry():
+    """The sentinel/guard counters are ABSORBED into the registry and
+    reported from it: the engine's warmup compile must show up in
+    stats()["recompile_events"] AND in the registry counter/dump. The
+    mutation audit carries a stats-drops-sentinel-counters mutant;
+    this is its named kill."""
+    srv = ArenaServer(
+        num_players=P, max_staleness_matches=0, donation_sample_every=1
+    )
+    w, l = make_matches(100, seed=4)
+    srv.engine.ingest(w, l)  # warmup compile -> one recompile event
+    stats = srv.stats()
+    assert stats["recompile_events"] >= 1
+    assert stats["donation_calls"] >= 1
+    reg = srv.obs.registry
+    assert reg.counter("arena_recompile_events_total").value == (
+        stats["recompile_events"]
+    )
+    assert stats["obs"]["counters"]["arena_recompile_events_total"] == (
+        stats["recompile_events"]
+    )
+    # Re-reads never double-count (delta absorption).
+    again = srv.stats()
+    assert again["recompile_events"] == stats["recompile_events"]
+
+
+def test_stats_is_one_json_line_with_query_latency_histogram():
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    w, l = make_matches(200, seed=5)
+    srv.engine.ingest(w, l)
+    srv.query(leaderboard=(0, 5), players=[0], pairs=[(0, 1)])
+    line = json.dumps(srv.stats())  # must be JSON-serializable whole
+    doc = json.loads(line)
+    assert doc["queries"] == 1
+    hist = doc["obs"]["histograms"]["arena_query_latency_seconds"]
+    assert hist["count"] == 1 and hist["p99"] is not None
+    assert "arena_query_staleness_matches" in doc["obs"]["histograms"]
+    assert "serve.query" in {n for n, *_ in srv.obs.tracer.spans()}
+    # Prometheus render of the same registry is non-empty and typed.
+    assert "# TYPE arena_queries_total counter" in srv.obs.render()
+
+
+def test_server_upgrades_null_engine_to_live_obs():
+    eng = ArenaEngine(P)
+    assert eng.obs is obs_pkg.NULL
+    srv = ArenaServer(engine=eng)
+    assert eng.obs is srv.obs and srv.obs.enabled
+    assert eng._store._obs is srv.obs  # store rewired too
+    # An explicit obs wins over everything.
+    o = obs_pkg.Observability()
+    srv2 = ArenaServer(num_players=P, obs=o)
+    assert srv2.obs is o and srv2.engine.obs is o
+
+
+# --- the pow2-padded bootstrap epoch (the recompile-source fix) ------------
+
+
+def test_bootstrap_refreshes_are_compile_free_as_history_grows():
+    """ROADMAP item 5's first half, pinned: with the pow2-padded epoch
+    layout and the per-engine cached resampler, interval refreshes as
+    history grows within a padded horizon add ZERO bootstrap compiles
+    after the first — and the padding batches are rating no-ops (same
+    samples as the tight layout would give for identical weights is
+    NOT asserted; determinism and zero compiles are)."""
+    eng = ArenaEngine(P)
+    w, l = make_matches(3000, seed=6)
+    eng.ingest(w[:1000], l[:1000])
+    # Horizon covers the whole test: every refresh shares one shape.
+    horizon = 8  # pow2 >= ceil(3000/512)
+    s1 = eng.bootstrap_ratings(num_rounds=4, seed=0, batch_size=512,
+                               min_batches=horizon)
+    compiles_after_first = eng.num_bootstrap_compiles()
+    assert compiles_after_first >= 1
+    eng.ingest(w[1000:2000], l[1000:2000])
+    eng.bootstrap_ratings(num_rounds=4, seed=0, batch_size=512,
+                          min_batches=horizon)
+    eng.ingest(w[2000:], l[2000:])
+    s3 = eng.bootstrap_ratings(num_rounds=4, seed=0, batch_size=512,
+                               min_batches=horizon)
+    assert eng.num_bootstrap_compiles() == compiles_after_first, (
+        "bootstrap recompiled as history grew inside the padded horizon"
+    )
+    assert s1.shape == (4, P) and s3.shape == (4, P)
+    # Deterministic under a fixed seed at fixed history.
+    s3b = eng.bootstrap_ratings(num_rounds=4, seed=0, batch_size=512,
+                                min_batches=horizon)
+    np.testing.assert_array_equal(s3, s3b)
+
+
+def test_pack_epoch_pow2_padding_batches_are_rating_noops():
+    """The padded epoch applies IDENTICAL ratings to the tight one:
+    padding batches are fully invalid (valid == 0), so the epoch scan
+    over them is a no-op."""
+    import jax.numpy as jnp
+
+    from arena import ratings as R
+    from arena.engine import pack_epoch
+
+    w, l = make_matches(700, seed=7)
+    tight = pack_epoch(P, w, l, 256)
+    padded = pack_epoch(P, w, l, 256, pad_batches_pow2=True, min_batches=8)
+    assert tight.winners.shape[0] == 3
+    assert padded.winners.shape[0] == 8
+    assert float(padded.valid[3:].sum()) == 0.0
+    fn = R.jit_elo_epoch(P, donate=False)
+    r0 = jnp.full((P,), R.DEFAULT_BASE, jnp.float32)
+    r_tight = fn(r0, tight.winners, tight.losers, tight.valid, tight.perms,
+                 tight.bounds)
+    r_pad = fn(r0, padded.winners, padded.losers, padded.valid, padded.perms,
+               padded.bounds)
+    np.testing.assert_array_equal(np.asarray(r_tight), np.asarray(r_pad))
