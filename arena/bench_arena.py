@@ -120,7 +120,13 @@ modes), ARENA_BENCH_SOAK_BATCHES (16), ARENA_BENCH_SOAK_REFRESH_EVERY
 (4), ARENA_BENCH_SOAK_SNAPSHOT_EVERY (4), ARENA_BENCH_OBS_TOL (0.03),
 ARENA_BENCH_OBS_ABS_S (0.005),
 ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
-sharded path when the backend is not yet initialized).
+sharded path when the backend is not yet initialized),
+ARENA_BENCH_HISTORY (unset — append every emitted JSON line to this
+JSON Lines file, the input of the `python -m arena.obs.regress`
+perf-regression watchdog), ARENA_DEBUG_DIR (unset — where HARD gate
+failures write their flight-recorder debug bundle; a temp dir
+otherwise. The rc-2 line carries the bundle path as "debug_bundle"
+for the instrumented modes: soak/serve/pipeline/ingest).
 """
 
 import json
@@ -154,6 +160,44 @@ import bench  # noqa: E402  (exc_detail — the repo-wide error formatting)
 from arena import baseline, engine, ingest, ratings, serving, sharding  # noqa: E402
 from arena import obs as obs_pkg  # noqa: E402
 from arena.analysis import sanitize  # noqa: E402
+from arena.obs import debug as obs_debug  # noqa: E402
+
+# The live observability handle of the CURRENT bench mode, registered
+# by each runner that has one (ingest/pipeline/serve/soak). When a
+# HARD gate fires, main() flight-records it — the rc-2 line then ships
+# a postmortem bundle path ("debug_bundle") next to the verdict
+# instead of leaving the operator with a bare exit code.
+_ACTIVE_OBS = None
+
+
+def _register_active_obs(obs):
+    global _ACTIVE_OBS
+    _ACTIVE_OBS = obs
+
+
+def _gate_debug_bundle(mode):
+    """Dump the registered live obs to a bundle and return its path
+    (None when the mode runs uninstrumented, e.g. elo). Best-effort:
+    the one-JSON-line contract outranks the bundle, so a failed dump
+    degrades to None, never to a crash that eats the verdict line."""
+    if _ACTIVE_OBS is None:
+        return None
+    try:
+        root = os.environ.get("ARENA_DEBUG_DIR") or tempfile.mkdtemp(
+            prefix="arena-debug-"
+        )
+        path = pathlib.Path(root) / f"bundle-{mode}"
+        obs_debug.dump_debug_bundle(_ACTIVE_OBS, path, config={
+            "mode": mode,
+            "argv": sys.argv,
+            "env": {
+                k: v for k, v in os.environ.items()
+                if k.startswith("ARENA_")
+            },
+        })
+        return str(path)
+    except Exception:  # noqa: BLE001 — the verdict line must still emit
+        return None
 
 # Max |rating diff| tolerated between the naive float64 loop and the
 # float32 scatter-free path, in rating points on the 1500 scale
@@ -437,6 +481,7 @@ def run_ingest_benchmark():
     # data). Null and live alternate within each repeat so cache and
     # scheduler state favor neither side. ----------------------------
     obs_live = obs_pkg.Observability()
+    _register_active_obs(obs_live)
     all_slices = _batch_slices(total, batch)
     null_build_s = float("inf")
     live_build_s = float("inf")
@@ -613,6 +658,7 @@ def run_pipeline_benchmark():
     # instrumentation-overhead gate's subject; the other three run the
     # default NullRegistry, i.e. the pre-instrumentation behavior).
     obs_live = obs_pkg.Observability()
+    _register_active_obs(obs_live)
     eng_sync = engine.ArenaEngine(num_players)
     eng_async = engine.ArenaEngine(num_players)
     eng_cold = engine.ArenaEngine(num_players)
@@ -794,6 +840,7 @@ def run_serve_benchmark():
         max_staleness_matches=stream_batch,
         bootstrap_rounds=bootstrap_rounds,
     )
+    _register_active_obs(srv.obs)
     for start, stop in _batch_slices(base_matches, batch):
         srv.engine.ingest(winners[start:stop], losers[start:stop])
 
@@ -978,6 +1025,7 @@ def run_soak_benchmark():
     min_epoch_batches = engine._pow2_ceil(-(-total // batch))
 
     obs_live = obs_pkg.Observability(trace_capacity=8192)
+    _register_active_obs(obs_live)
     srv = serving.ArenaServer(
         num_players=num_players,
         max_staleness_matches=stream_batch,
@@ -1095,6 +1143,15 @@ def run_soak_benchmark():
     streamed = stream_batch * soak_batches
     p50 = lat_hist.percentile(0.5)
     p99 = lat_hist.percentile(0.99)
+    # Causal-diagnosis accounting for the line: orphan spans modulo the
+    # explicit evicted-parent marker (tier-1 pins zero dangling), and
+    # the exemplar behind the p99 query-latency bucket — the trace id a
+    # human starts the postmortem from.
+    dangling_orphans = sum(
+        1 for _rec, reason in obs_live.tracer.orphans()
+        if reason == "dangling"
+    )
+    p99_exemplar = lat_hist.exemplar(0.99)
     return {
         "metric": "arena_soak",
         "value": round(p99 * 1e3, 3) if p99 is not None else -1,
@@ -1135,6 +1192,8 @@ def run_soak_benchmark():
             "spilled_batches": stats["pipeline"]["spilled_batches"],
             "trace_spans_recorded": obs_live.tracer.recorded,
             "trace_dropped": obs_live.tracer.dropped,
+            "trace_dangling_orphans": dangling_orphans,
+            "p99_exemplar": p99_exemplar,
             "max_view_mass_dev": round(max_mass_dev[0], 6),
         },
         "equivalence_ok": True,
@@ -1156,8 +1215,9 @@ def main() -> int:
         line = json.dumps(runner())
     except EquivalenceError as exc:
         # A measured verdict, not a crash: the paths diverged, so the
-        # line carries the divergence instead of a speedup and the
-        # process exits the distinct equivalence-failure code.
+        # line carries the divergence instead of a speedup — plus the
+        # flight-recorder bundle path (the process's last flight) —
+        # and the process exits the distinct equivalence-failure code.
         line = json.dumps(
             {
                 "metric": "arena_bench_equivalence_failure",
@@ -1167,6 +1227,7 @@ def main() -> int:
                 "max_rating_diff": round(exc.max_diff, 6),
                 "tolerance": exc.tol,
                 "error": str(exc),
+                "debug_bundle": _gate_debug_bundle(mode),
             }
         )
         rc = EXIT_EQUIVALENCE_FAILURE
@@ -1185,6 +1246,7 @@ def main() -> int:
                 "null_s": round(exc.null_s, 6),
                 "live_s": round(exc.live_s, 6),
                 "error": str(exc),
+                "debug_bundle": _gate_debug_bundle(mode),
             }
         )
         rc = EXIT_EQUIVALENCE_FAILURE
@@ -1198,6 +1260,7 @@ def main() -> int:
                 "unit": unit,
                 "vs_baseline": None,
                 "error": str(exc),
+                "debug_bundle": _gate_debug_bundle(mode),
             }
         )
         rc = EXIT_EQUIVALENCE_FAILURE
@@ -1211,6 +1274,18 @@ def main() -> int:
                 "error": bench.exc_detail(exc),
             }
         )
+    # Perf-watchdog history: with ARENA_BENCH_HISTORY set, every
+    # emitted line (verdicts included — their distinct metric names are
+    # simply never pinned) is ALSO appended to the JSON Lines history
+    # file `python -m arena.obs.regress` compares against the pinned
+    # BENCH_BASELINE.json. Best-effort: the stdout contract owns rc.
+    history_path = os.environ.get("ARENA_BENCH_HISTORY")
+    if history_path:
+        try:
+            with open(history_path, "a") as fh:
+                fh.write(line + "\n")
+        except OSError:
+            pass
     # Same single-write discipline as bench.py: one fully-serialized
     # line, flush inside the guard, nothing appended after a failure.
     try:

@@ -324,11 +324,14 @@ class ArenaEngine:
     def update(self, winners, losers):
         """Ingest one batch of outcomes and apply one batched Elo round."""
         self._drain_pipeline()
-        packed = pack_batch(
-            self.num_players, winners, losers, self.min_bucket, np.float32
-        )
-        self._store.add(winners, losers)
-        return self._apply(packed)
+        # Root span: this batch's trace id — every nested stage span
+        # (store add, jit dispatch) parents under it (arena.obs.context).
+        with self.obs.span("batch.update"):
+            packed = pack_batch(
+                self.num_players, winners, losers, self.min_bucket, np.float32
+            )
+            self._store.add(winners, losers)
+            return self._apply(packed)
 
     def _ensure_staging(self):
         if self._staging is None:
@@ -366,11 +369,14 @@ class ArenaEngine:
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
         _validate_matches(self.num_players, w, l)
-        self._ensure_staging()
-        self._store.add(w, l)
-        if w.shape[0] == 0:
-            return self.ratings  # nothing to dispatch
-        return self._dispatch_packed(self._staging.stage(w, l))
+        # Root span: the sync-path batch trace (csr merge, staging,
+        # dispatch, apply all nest under it on this thread).
+        with self.obs.span("batch.ingest"):
+            self._ensure_staging()
+            self._store.add(w, l)
+            if w.shape[0] == 0:
+                return self.ratings  # nothing to dispatch
+            return self._dispatch_packed(self._staging.stage(w, l))
 
     # --- the overlapped (async) ingest path --------------------------
 
@@ -386,10 +392,11 @@ class ArenaEngine:
             return None
         return self._staging.stage(w, l, block=True)
 
-    def start_pipeline(self, capacity=None, policy=None):
+    def start_pipeline(self, capacity=None, policy=None, producer=None):
         """Explicitly start the overlapped-ingest pipeline (to pick a
-        queue capacity/backpressure policy); `ingest_async` starts one
-        with defaults on first use otherwise."""
+        queue capacity/backpressure policy, or a `producer` metric
+        label for a multi-producer front door); `ingest_async` starts
+        one with defaults on first use otherwise."""
         from arena import pipeline as pipeline_mod
 
         if self._pipeline is not None:
@@ -402,6 +409,8 @@ class ArenaEngine:
             kwargs["capacity"] = capacity
         if policy is not None:
             kwargs["policy"] = policy
+        if producer is not None:
+            kwargs["producer"] = producer
         self._pipeline = pipeline_mod.IngestPipeline(self, **kwargs)
         return self._pipeline
 
@@ -420,7 +429,12 @@ class ArenaEngine:
         _validate_matches(self.num_players, w, l)
         if self._pipeline is None:
             self.start_pipeline()
-        self._pipeline.submit(w, l)
+        # Root span: the async batch's trace id. submit() captures the
+        # context inside this span and ships it with the queue item, so
+        # the packer's pack/merge spans and the eventual dispatch spans
+        # — on whatever threads they run — parent back to THIS root.
+        with self.obs.span("batch.submit"):
+            self._pipeline.submit(w, l)
         return self._pipeline.pending()
 
     def flush(self):

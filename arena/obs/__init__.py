@@ -1,17 +1,30 @@
-"""arena.obs — zero-dependency observability: metrics + stage tracing.
+"""arena.obs — zero-dependency observability: metrics, tracing, diagnosis.
 
 The measurement substrate every subsystem reports through (and every
 later PR — network tier, replicas, multi-host — will report through):
 
 - `arena.obs.metrics`  — thread-safe registry of counters, gauges, and
   fixed-bucket log2 histograms over preallocated numpy arrays, with a
-  Prometheus-style text `render()` and a one-JSON-line `dump()`.
+  Prometheus-style text `render()`, a one-JSON-line `dump()`, and
+  per-bucket `(trace_id, value)` latency exemplars.
 - `arena.obs.tracing`  — monotonic-clock stage spans in a bounded
-  overwrite-oldest ring buffer, exportable as Chrome trace-event JSON.
+  overwrite-oldest ring buffer with MONOTONIC span ids and
+  parent/trace links, exportable as Chrome trace-event JSON with
+  cross-thread flow events.
+- `arena.obs.context`  — the thread-local / cross-thread trace-context
+  carrier (`TraceContext`, `attach`) that turns isolated spans into
+  one causal tree per request.
+- `arena.obs.debug`    — the flight recorder: `dump_debug_bundle()`
+  atomically writes one postmortem directory (Chrome trace, registry
+  dump, config, recent events + queue-depth timeline).
+- `arena.obs.regress`  — the perf-regression watchdog CLI
+  (`python -m arena.obs.regress`) comparing the newest bench-history
+  line against a pinned baseline.
 
-`Observability` bundles one registry + one tracer behind the small
-surface the instrumented modules call (`span`/`counter`/`gauge`/
-`histogram`/`dump`/`render`), and `NULL` is the shared no-op instance:
+`Observability` bundles one registry + one tracer (+ a bounded recent-
+event log for the flight recorder) behind the small surface the
+instrumented modules call (`span`/`counter`/`gauge`/`histogram`/
+`event`/`dump`/`render`), and `NULL` is the shared no-op instance:
 every call is a constant-time no-op, nothing allocates, nothing is
 recorded. `ArenaEngine` defaults to `NULL` (a library user who never
 asked for metrics pays a method call, not a measurement — and the
@@ -25,6 +38,10 @@ run) on boxes with no accelerator stack, the same rule as the linter
 half of `arena/analysis`.
 """
 
+import time
+from collections import deque
+
+from arena.obs.context import TraceContext, attach, current as current_context
 from arena.obs.metrics import (
     DEFAULT_LATENCY_BASE,
     DEFAULT_NUM_BUCKETS,
@@ -34,17 +51,24 @@ from arena.obs.metrics import (
     NullRegistry,
     Registry,
 )
-from arena.obs.tracing import NullTracer, Tracer
+from arena.obs.tracing import NullTracer, SpanRecord, Tracer
+
+# Recent structured events kept for the flight recorder (drops, spills,
+# queue-depth samples). Bounded: a long soak keeps the newest.
+DEFAULT_EVENT_CAPACITY = 1024
 
 
 class Observability:
-    """One registry + one tracer, behind the instrumentation surface."""
+    """One registry + one tracer + one bounded recent-event log, behind
+    the instrumentation surface."""
 
     enabled = True
 
-    def __init__(self, registry=None, tracer=None, trace_capacity=4096):
+    def __init__(self, registry=None, tracer=None, trace_capacity=4096,
+                 event_capacity=DEFAULT_EVENT_CAPACITY):
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else Tracer(trace_capacity)
+        self.events = deque(maxlen=event_capacity)
 
     # --- delegation (the only calls instrumented modules make) -------
 
@@ -63,17 +87,26 @@ class Observability:
             name, base=base, num_buckets=num_buckets, **labels
         )
 
+    def event(self, kind, **fields):
+        """Append one structured event (monotonic timestamp + kind +
+        fields) to the bounded recent-event log — the drop/spill/
+        queue-depth record the flight recorder bundles. Cheap (one
+        dict + deque append per EVENT, not per match) and fixed
+        memory; never read on the hot path."""
+        self.events.append({"t": time.perf_counter(), "kind": kind, **fields})
+
     def render(self):
         """Prometheus text exposition of the registry."""
         return self.registry.render()
 
     def dump(self):
-        """One JSON-able dict: metrics + trace accounting."""
+        """One JSON-able dict: metrics + trace/event accounting."""
         out = self.registry.dump()
         out["trace"] = {
             "spans_recorded": self.tracer.recorded,
             "trace_dropped": self.tracer.dropped,
             "capacity": self.tracer.capacity,
+            "events_recorded": len(self.events),
         }
         return out
 
@@ -85,7 +118,11 @@ class _NullObservability(Observability):
     enabled = False
 
     def __init__(self):
-        super().__init__(registry=NullRegistry(), tracer=NullTracer())
+        super().__init__(registry=NullRegistry(), tracer=NullTracer(),
+                         event_capacity=1)
+
+    def event(self, kind, **fields):
+        return None
 
 
 NULL = _NullObservability()
@@ -99,7 +136,12 @@ __all__ = [
     "NullTracer",
     "Observability",
     "Registry",
+    "SpanRecord",
+    "TraceContext",
     "Tracer",
+    "attach",
+    "current_context",
+    "DEFAULT_EVENT_CAPACITY",
     "DEFAULT_LATENCY_BASE",
     "DEFAULT_NUM_BUCKETS",
 ]

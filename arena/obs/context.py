@@ -1,0 +1,101 @@
+"""Trace-context propagation: one request's identity across threads.
+
+`arena/obs/tracing.py` records WHERE time went (named spans in a ring);
+this module records WHOSE time it was. A `TraceContext` is the tiny
+immutable pair `(trace_id, span_id)` — the trace a request belongs to
+and the span that should adopt any work done on its behalf — and the
+machinery here moves that pair across the two boundaries the pipeline
+has:
+
+1. **Within a thread**: a thread-local STACK of contexts. A live span
+   pushes its own context on enter and pops on exit, so nested spans
+   link parent→child with no caller involvement (`engine.apply` inside
+   `pipeline.dispatch` inside a batch root just works). `current()`
+   reads the innermost entry; when the stack is empty there is no
+   active request and a new span becomes a ROOT of a fresh trace.
+
+2. **Across threads**: contexts are plain values, so a producer
+   captures `current()` and ships it along with the work item (the
+   ingest queue carries one per raw batch); the consumer wraps its
+   processing in `attach(ctx)`, which pushes the foreign context onto
+   ITS thread-local stack for the duration. The packer thread's
+   `pipeline.pack` span then parents to the producer's `batch.submit`
+   span — the cross-thread chain the Chrome export draws flow arrows
+   for. `attach(None)` is an explicit no-op (the null-observability
+   path never creates contexts, so consumers attach unconditionally).
+
+Deliberately NOT context-var magic: a thread-local list is the whole
+mechanism, it is obvious under a debugger, and it costs one attribute
+read per span on the hot path. No jax imports (the arena/obs rule),
+and no clock reads — this module carries identity, it never times
+anything (the jaxlint `timing-without-block` rule has nothing to see
+here; the tier-1 lint test pins that an `attach`-wrapped dispatch
+lints clean).
+"""
+
+import threading
+from typing import NamedTuple
+
+
+class TraceContext(NamedTuple):
+    """One request's identity: the trace it belongs to and the span new
+    work should parent to. Plain value — safe to ship across threads
+    inside queue items."""
+
+    trace_id: int
+    span_id: int
+
+
+_local = threading.local()
+
+
+def _stack():
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    return stack
+
+
+def current():
+    """The innermost active context on THIS thread, or None when no
+    span (and no attach) is live — in which case the next span opened
+    here becomes the root of a fresh trace."""
+    stack = getattr(_local, "stack", None)
+    return stack[-1] if stack else None
+
+
+def push(ctx):
+    """Make `ctx` the current context (span enter / attach enter)."""
+    _stack().append(ctx)
+    return ctx
+
+
+def pop():
+    """Undo the matching `push` (span exit / attach exit)."""
+    _stack().pop()
+
+
+class attach:
+    """Adopt a context captured on another thread for a `with` block.
+
+    The consumer half of cross-thread propagation: work done inside the
+    block parents to `ctx.span_id` and joins `ctx.trace_id`. `ctx` may
+    be None (nothing was live when the producer captured — the null
+    path), making the block a no-op; consumers attach unconditionally
+    instead of branching.
+    """
+
+    __slots__ = ("_ctx",)
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    def __enter__(self):
+        if self._ctx is not None:
+            push(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._ctx is not None:
+            pop()
+        return False

@@ -1,0 +1,84 @@
+"""Flight recorder: one atomic postmortem bundle instead of a bare rc 2.
+
+When a HARD bench gate fires (equivalence divergence, instrumentation
+overhead, a recompile in the soak's steady state) the one-JSON-line
+contract gives the driver a verdict — but a human debugging the
+failure needs the evidence that was live in the process at that
+moment: the span ring (what every thread was doing), the full metrics
+registry (every counter/histogram, exemplars included), the run's
+configuration, and the recent structured events (drops, spills, the
+queue-depth timeline). `dump_debug_bundle()` captures all of it as one
+directory:
+
+    <path>/
+      MANIFEST.json   what's here + trace/event accounting
+      trace.json      Chrome trace-event export (chrome://tracing)
+      metrics.json    full registry dump (counters/gauges/histograms)
+      config.json     caller-provided run configuration
+      events.json     recent events + extracted queue-depth timeline
+
+The write is ATOMIC at the directory level: everything lands in a
+`<path>.tmp` sibling first and the complete directory is renamed into
+place last, so a crash mid-dump leaves no half-bundle at `path` (the
+same torn-write discipline as the serving snapshot's manifest-last
+ordering). An existing bundle at `path` is replaced.
+
+Every hard bench gate (`arena/bench_arena.py` soak/serve/pipeline/
+ingest modes) calls this on failure and ships the bundle path in its
+rc-2 JSON line (`"debug_bundle"`), turning "the gate fired" into "the
+gate fired, and here is the process's last flight". No jax imports
+(the arena/obs rule); stdlib + the passed-in observability handle
+only.
+"""
+
+import json
+import pathlib
+import shutil
+import time
+
+
+def dump_debug_bundle(obs, path, config=None):
+    """Write one postmortem bundle for `obs` at directory `path`.
+
+    `obs` is an `arena.obs.Observability` (a null instance produces an
+    honest mostly-empty bundle); `config` is any JSON-able dict worth
+    having next to the evidence (bench params, env knobs). Returns the
+    final `pathlib.Path`. Atomic: `path` either holds the previous
+    complete bundle or the new complete bundle, never a partial one.
+    """
+    path = pathlib.Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    if tmp.exists():
+        shutil.rmtree(tmp)
+    tmp.mkdir(parents=True)
+    (tmp / "trace.json").write_text(obs.tracer.export_chrome_trace_json())
+    (tmp / "metrics.json").write_text(
+        json.dumps(obs.registry.dump(), indent=1, sort_keys=True)
+    )
+    (tmp / "config.json").write_text(
+        json.dumps(config or {}, indent=1, sort_keys=True, default=str)
+    )
+    events = list(obs.events)
+    (tmp / "events.json").write_text(json.dumps({
+        "events": events,
+        # The queue-depth timeline, extracted for direct plotting:
+        # (monotonic seconds, depth) per submit-path sample.
+        "queue_depth_timeline": [
+            [e["t"], e["depth"]]
+            for e in events
+            if e.get("kind") == "queue_depth" and "depth" in e
+        ],
+    }, indent=1))
+    (tmp / "MANIFEST.json").write_text(json.dumps({
+        "bundle": "arena-debug",
+        "written_at_unix": time.time(),
+        "files": ["trace.json", "metrics.json", "config.json",
+                  "events.json"],
+        "spans_recorded": obs.tracer.recorded,
+        "trace_dropped": obs.tracer.dropped,
+        "events_recorded": len(events),
+    }, indent=1, sort_keys=True))
+    if path.exists():
+        shutil.rmtree(path)
+    tmp.rename(path)
+    return path

@@ -104,10 +104,19 @@ class Histogram:
     the last bound land in the overflow slot (rendered `le="+Inf"`).
     Zero/negative values land in bucket 0 (latencies and depths are
     non-negative; a clock hiccup must not throw).
+
+    **Exemplars**: `record(value, trace_id=...)` additionally stores a
+    latest-wins `(trace_id, value)` exemplar IN THE VALUE'S BUCKET,
+    under the same per-metric lock (two extra scalar stores into
+    preallocated arrays — no allocation, no second lock). `exemplar(q)`
+    returns the exemplar of the bucket containing quantile q, which is
+    how "show me the trace behind the p99" resolves: the trace id keys
+    into `Tracer.trace()`. A zero/None trace id records no exemplar, so
+    uninstrumented callers pay nothing.
     """
 
     __slots__ = ("name", "labels", "base", "bounds", "_counts", "_sum",
-                 "_count", "_lock")
+                 "_count", "_lock", "_ex_trace", "_ex_value")
 
     def __init__(self, name, labels, base=DEFAULT_LATENCY_BASE,
                  num_buckets=DEFAULT_NUM_BUCKETS):
@@ -123,6 +132,9 @@ class Histogram:
         self._counts = np.zeros(num_buckets + 1, np.int64)  # [+Inf] last
         self._sum = np.zeros(1, np.float64)
         self._count = np.zeros(1, np.int64)
+        # Latest-wins exemplar per bucket: trace id 0 = no exemplar.
+        self._ex_trace = np.zeros(num_buckets + 1, np.int64)
+        self._ex_value = np.zeros(num_buckets + 1, np.float64)
         self._lock = threading.Lock()
 
     def bucket_index(self, value):
@@ -130,12 +142,15 @@ class Histogram:
         len(bounds) for overflow."""
         return int(np.searchsorted(self.bounds, value, side="left"))
 
-    def record(self, value):
+    def record(self, value, trace_id=None):
         idx = self.bucket_index(value)
         with self._lock:
             self._counts[idx] += 1
             self._sum[0] += value
             self._count[0] += 1
+            if trace_id:
+                self._ex_trace[idx] = trace_id
+                self._ex_value[idx] = value
 
     @property
     def count(self):
@@ -144,6 +159,14 @@ class Histogram:
     @property
     def sum(self):
         return float(self._sum[0])
+
+    @staticmethod
+    def _quantile_bucket(counts, total, q):
+        """Index of the bucket containing quantile q (counts cumulated
+        in place here; callers pass a consistent copy)."""
+        target = q * total
+        cum = np.cumsum(counts)
+        return int(np.searchsorted(cum, target, side="left"))
 
     def percentile(self, q):
         """Upper bound of the bucket containing quantile q in [0, 1].
@@ -157,28 +180,68 @@ class Histogram:
             total = int(self._count[0])
             if total == 0:
                 return None
-            target = q * total
-            cum = np.cumsum(self._counts)
-            idx = int(np.searchsorted(cum, target, side="left"))
+            idx = self._quantile_bucket(self._counts, total, q)
         if idx >= self.bounds.size:
             return float("inf")
         return float(self.bounds[idx])
 
+    def exemplar(self, q):
+        """The exemplar stored in quantile q's bucket: a dict with
+        `trace_id` (keys into `Tracer.trace()`), the recorded `value`,
+        and the `bucket_index` — the "show me the trace behind the p99"
+        read. None when the histogram is empty or that bucket never
+        recorded a traced value."""
+        with self._lock:
+            total = int(self._count[0])
+            if total == 0:
+                return None
+            idx = self._quantile_bucket(self._counts, total, q)
+            tid = int(self._ex_trace[idx])
+            if tid == 0:
+                return None
+            return {
+                "trace_id": tid,
+                "value": float(self._ex_value[idx]),
+                "bucket_index": idx,
+            }
+
+    def exemplars(self):
+        """Every stored exemplar as `(bucket_index, trace_id, value)`,
+        bucket order (a consistent snapshot under the metric lock)."""
+        with self._lock:
+            return [
+                (i, int(t), float(v))
+                for i, (t, v) in enumerate(
+                    zip(self._ex_trace, self._ex_value)
+                )
+                if t
+            ]
+
     def snapshot(self):
-        """JSON-able summary: count, sum, p50/p99, per-bucket counts."""
+        """JSON-able summary: count, sum, p50/p99, per-bucket counts,
+        per-bucket exemplars (keyed like `buckets`, overflow as
+        "overflow")."""
         with self._lock:
             counts = self._counts.copy()
             total = int(self._count[0])
             s = float(self._sum[0])
+            ex_trace = self._ex_trace.copy()
+            ex_value = self._ex_value.copy()
+        bucket_keys = [f"{float(b):g}" for b in self.bounds] + ["overflow"]
         out = {
             "count": total,
             "sum": round(s, 9),
             "buckets": {
-                f"{float(b):g}": int(c)
-                for b, c in zip(self.bounds, counts[:-1])
+                key: int(c)
+                for key, c in zip(bucket_keys[:-1], counts[:-1])
                 if c
             },
             "overflow": int(counts[-1]),
+            "exemplars": {
+                key: {"trace_id": int(t), "value": float(v)}
+                for key, t, v in zip(bucket_keys, ex_trace, ex_value)
+                if t
+            },
         }
         for name, q in (("p50", 0.5), ("p99", 0.99)):
             p = self.percentile(q)
@@ -259,11 +322,21 @@ class Registry:
                     counts = metric._counts.copy()
                     total = int(metric._count[0])
                     s = float(metric._sum[0])
+                    ex_trace = metric._ex_trace.copy()
+                    ex_value = metric._ex_value.copy()
                 cum = 0
-                for bound, c in zip(metric.bounds, counts[:-1]):
+                for i, (bound, c) in enumerate(zip(metric.bounds, counts[:-1])):
                     cum += int(c)
                     le = _label_suffix({**metric.labels, "le": f"{float(bound):g}"})
-                    lines.append(f"{name}_bucket{le} {cum}")
+                    # OpenMetrics-style exemplar suffix: the trace id
+                    # behind this bucket's latest traced observation.
+                    ex = (
+                        f' # {{trace_id="{int(ex_trace[i])}"}} '
+                        f"{float(ex_value[i]):g}"
+                        if ex_trace[i]
+                        else ""
+                    )
+                    lines.append(f"{name}_bucket{le} {cum}{ex}")
                 le = _label_suffix({**metric.labels, "le": "+Inf"})
                 lines.append(f"{name}_bucket{le} {total}")
                 lines.append(f"{name}_sum{suffix} {s:g}")
@@ -314,7 +387,7 @@ class _NullHistogram:
     count = 0
     sum = 0.0
 
-    def record(self, value):
+    def record(self, value, trace_id=None):
         return None
 
     def bucket_index(self, value):
@@ -323,9 +396,15 @@ class _NullHistogram:
     def percentile(self, q):
         return None
 
+    def exemplar(self, q):
+        return None
+
+    def exemplars(self):
+        return []
+
     def snapshot(self):
         return {"count": 0, "sum": 0.0, "buckets": {}, "overflow": 0,
-                "p50": None, "p99": None}
+                "exemplars": {}, "p50": None, "p99": None}
 
 
 class NullRegistry:

@@ -1,0 +1,254 @@
+"""Perf-regression watchdog: the bench trajectory as a machine-checked gate.
+
+Every bench mode emits one JSON line (`arena/bench_arena.py`), and with
+`ARENA_BENCH_HISTORY=<file>` set it also APPENDS that line to a history
+file — JSON Lines, one run per line, newest last. Until this module the
+trajectory (the BENCH_r*.json records) was checked only by a human
+reading JSON; the watchdog makes it a gate:
+
+    python -m arena.obs.regress --history bench_history.jsonl \
+        --baseline BENCH_BASELINE.json
+
+compares the NEWEST history run of every baseline-pinned metric against
+its pinned value with a noise-aware per-metric tolerance, prints one
+JSON verdict line, and exits:
+
+    rc 0  every pinned metric within tolerance (improvements included —
+          a speedup is never a failure)
+    rc 1  at least one REGRESSION beyond tolerance (a measured verdict)
+    rc 2  bad input: unreadable/corrupt history or baseline, empty
+          history, a pinned metric with no history run, a malformed pin
+          (nothing was measured — never conflated with rc 1, the same
+          crash-vs-verdict discipline as the repo's other gates)
+
+**History-file schema**: JSON Lines; each line is a bench_arena.py
+output line — the watchdog reads only `metric` (the name) and `value`
+(the headline number) and ignores failure lines (their metric names,
+e.g. `arena_bench_equivalence_failure`, are simply never pinned).
+
+**Baseline schema** (`BENCH_BASELINE.json` pins this repo's measured
+trajectory):
+
+    {"metrics": {
+        "arena_ingest": {"value": 15.5, "direction": "higher",
+                          "tolerance": 0.30},
+        "arena_soak":   {"value": 0.256, "direction": "lower"}}}
+
+`direction` says which way is good: `"higher"` for throughputs and
+speedups (regression = value below `pinned * (1 - tol)`), `"lower"`
+for latencies (regression = value above `pinned * (1 + tol)`). A value
+EXACTLY at the tolerance bound passes — the tolerance is the allowance,
+not the tripwire. `tolerance` is optional: when omitted, a NOISE-AWARE
+tolerance is derived from the metric's own prior history runs (3x the
+relative standard deviation of all runs before the newest, floored at
+`--tolerance`, default 0.10) — a metric that historically wobbles 5%
+gets a wider band than one that repeats to 0.1%, without hand-tuning
+every pin.
+
+No jax imports (the arena/obs rule): the watchdog must run anywhere
+the history file can be read.
+"""
+
+import argparse
+import json
+import math
+import pathlib
+import sys
+
+DEFAULT_TOLERANCE_FLOOR = 0.10
+NOISE_MULTIPLIER = 3.0
+DIRECTIONS = ("higher", "lower")
+
+RC_OK = 0
+RC_REGRESSION = 1
+RC_BAD_INPUT = 2
+
+DEFAULT_BASELINE = "BENCH_BASELINE.json"
+DEFAULT_HISTORY = "bench_history.jsonl"
+
+
+class WatchdogInputError(ValueError):
+    """History or baseline unusable: nothing measurable (rc 2)."""
+
+
+def _numeric(x):
+    return isinstance(x, (int, float)) and not isinstance(x, bool) and (
+        not isinstance(x, float) or math.isfinite(x)
+    )
+
+
+def load_history(path):
+    """Parse a JSON Lines history file. Every non-empty line must be a
+    JSON object; a corrupt line is BAD INPUT (named with its line
+    number), never silently skipped — a half-written history must not
+    quietly shrink the evidence."""
+    path = pathlib.Path(path)
+    try:
+        text = path.read_text()
+    except OSError as exc:
+        raise WatchdogInputError(f"unreadable history {path}: {exc}") from exc
+    runs = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError as exc:
+            raise WatchdogInputError(
+                f"corrupt history line {lineno} in {path}: {exc}"
+            ) from exc
+        if not isinstance(doc, dict):
+            raise WatchdogInputError(
+                f"history line {lineno} in {path} is not a JSON object"
+            )
+        runs.append(doc)
+    return runs
+
+
+def load_baseline(path):
+    """Parse and validate the baseline pin file."""
+    path = pathlib.Path(path)
+    try:
+        doc = json.loads(path.read_text())
+    except OSError as exc:
+        raise WatchdogInputError(f"unreadable baseline {path}: {exc}") from exc
+    except ValueError as exc:
+        raise WatchdogInputError(f"corrupt baseline {path}: {exc}") from exc
+    metrics = doc.get("metrics") if isinstance(doc, dict) else None
+    if not isinstance(metrics, dict) or not metrics:
+        raise WatchdogInputError(
+            f"baseline {path} must carry a non-empty 'metrics' object"
+        )
+    for name, pin in metrics.items():
+        if not isinstance(pin, dict) or not _numeric(pin.get("value")):
+            raise WatchdogInputError(
+                f"baseline metric {name!r} needs a numeric 'value', "
+                f"found {pin!r}"
+            )
+        if pin.get("direction") not in DIRECTIONS:
+            raise WatchdogInputError(
+                f"baseline metric {name!r} direction must be one of "
+                f"{DIRECTIONS}, found {pin.get('direction')!r}"
+            )
+        tol = pin.get("tolerance")
+        if tol is not None and (not _numeric(tol) or tol < 0):
+            raise WatchdogInputError(
+                f"baseline metric {name!r} tolerance must be a "
+                f"non-negative number, found {tol!r}"
+            )
+    return doc
+
+
+def noise_tolerance(prior_values, floor):
+    """Noise-aware tolerance: NOISE_MULTIPLIER x the relative standard
+    deviation of the metric's prior runs, floored. Fewer than 3 priors
+    (or a zero mean) is not enough signal — the floor applies."""
+    if len(prior_values) < 3:
+        return floor
+    mean = sum(prior_values) / len(prior_values)
+    if mean == 0:
+        return floor
+    var = sum((v - mean) ** 2 for v in prior_values) / len(prior_values)
+    return max(floor, NOISE_MULTIPLIER * math.sqrt(var) / abs(mean))
+
+
+def regressed(value, base, tol, direction):
+    """True when `value` is beyond the tolerance band on the BAD side.
+
+    Exactly AT the band edge passes; improvements (the good side, any
+    size) always pass — the watchdog polices regressions, it never
+    punishes a speedup.
+    """
+    if direction == "higher":
+        return value < base * (1.0 - tol)
+    return value > base * (1.0 + tol)
+
+
+def compare(history, baseline, tolerance_floor=DEFAULT_TOLERANCE_FLOOR):
+    """Compare the newest history run of every pinned metric against
+    its baseline pin. Returns the verdict report; raises
+    `WatchdogInputError` when nothing measurable exists (empty history,
+    a pinned metric with no run)."""
+    if not history:
+        raise WatchdogInputError("history is empty: nothing to compare")
+    by_metric = {}
+    for run in history:
+        name = run.get("metric")
+        value = run.get("value")
+        if isinstance(name, str) and _numeric(value):
+            by_metric.setdefault(name, []).append(float(value))
+    report = {"metrics": {}, "regressions": []}
+    for name, pin in sorted(baseline["metrics"].items()):
+        values = by_metric.get(name)
+        if not values:
+            raise WatchdogInputError(
+                f"baseline metric {name!r} has no run in the history"
+            )
+        newest = values[-1]
+        base = float(pin["value"])
+        tol = pin.get("tolerance")
+        tol_source = "baseline"
+        if tol is None:
+            tol = noise_tolerance(values[:-1], tolerance_floor)
+            tol_source = "history-noise"
+        is_reg = regressed(newest, base, float(tol), pin["direction"])
+        entry = {
+            "value": newest,
+            "baseline": base,
+            "direction": pin["direction"],
+            "tolerance": round(float(tol), 6),
+            "tolerance_source": tol_source,
+            "delta_frac": round(newest / base - 1.0, 6) if base else None,
+            "runs_seen": len(values),
+            "regressed": is_reg,
+        }
+        report["metrics"][name] = entry
+        if is_reg:
+            report["regressions"].append(name)
+    report["unpinned"] = sorted(set(by_metric) - set(baseline["metrics"]))
+    return report
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m arena.obs.regress",
+        description="Compare the newest bench-history run against the "
+        "pinned baseline (rc 0 ok / rc 1 regression / rc 2 bad input)",
+    )
+    parser.add_argument(
+        "--history", default=DEFAULT_HISTORY,
+        help="JSON Lines bench history (append via ARENA_BENCH_HISTORY)",
+    )
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="pinned baseline JSON (see module docstring for the schema)",
+    )
+    parser.add_argument(
+        "--tolerance", type=float, default=DEFAULT_TOLERANCE_FLOOR,
+        help="tolerance floor for metrics without an explicit pin "
+        "(noise-aware derivation never goes below this)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        if args.tolerance < 0:
+            raise WatchdogInputError(
+                f"--tolerance must be >= 0, got {args.tolerance}"
+            )
+        history = load_history(args.history)
+        baseline = load_baseline(args.baseline)
+        report = compare(history, baseline, tolerance_floor=args.tolerance)
+    except WatchdogInputError as exc:
+        print(json.dumps({
+            "check": "perf_watchdog",
+            "verdict": "bad-input",
+            "error": str(exc),
+        }))
+        return RC_BAD_INPUT
+    report["check"] = "perf_watchdog"
+    report["verdict"] = "regression" if report["regressions"] else "ok"
+    print(json.dumps(report))
+    return RC_REGRESSION if report["regressions"] else RC_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,18 @@ restore) wraps itself in `tracer.span(name)` — a context manager that
 reads `time.perf_counter()` on enter and exit and records one
 fixed-size row into preallocated ring arrays.
 
+Since the trace-context layer (`arena/obs/context.py`) every span is
+CAUSAL, not just named: on enter it allocates a MONOTONIC span id
+(a never-reset counter, so ids survive ring wraparound) and resolves
+its parent from the thread-local context — the enclosing span on this
+thread, or a `TraceContext` attached from another thread (the pipeline
+ships one per queue item). A span with no context becomes the ROOT of
+a fresh trace id. The result is that a full cross-thread request chain
+(batch submit → enqueue wait → pack → CSR merge → compaction → staging
+→ jit dispatch → apply; query → view build) reconstructs as one tree
+from the ring, and `trace(trace_id)` pulls exactly one request's spans
+— the read that turns a p99 histogram exemplar back into a story.
+
 Honest-timing note: spans time HOST stages — work that is complete
 when `__exit__` runs (NumPy packing, lock waits, file IO, dispatch
 issue). They are NOT a device-time measurement: a span around an
@@ -22,9 +34,18 @@ live inside `_Span`, not interleaved with the caller's dispatches).
 The ring is bounded and overwrite-oldest: a long soak keeps the NEWEST
 `capacity` spans and counts what it dropped (`dropped` — exposed as
 the `trace_dropped` counter in dumps), so tracing can stay on in
-production without growing memory. Export is Chrome trace-event JSON
-(`chrome://tracing`, Perfetto): complete "X" events with microsecond
-timestamps, one row per span, thread id preserved.
+production without growing memory. Eviction can orphan a kept child
+whose parent row was overwritten (parents record AFTER their children,
+but a batch root records at submit-return while its dispatch span can
+land much later); because span ids are monotonic and never reused,
+`orphans()` distinguishes that legitimate `evicted-parent` case from a
+`dangling` id that was never allocated (a bug), and the Chrome export
+re-roots evicted-parent spans under an explicit synthetic
+`evicted-parent` event instead of leaving dangling ids. Export is
+Chrome trace-event JSON (`chrome://tracing`, Perfetto): complete "X"
+events with microsecond timestamps, span/parent/trace ids in `args`,
+and flow events ("s"/"f") drawing the arrows for every cross-thread
+parent→child edge (producer thread → packer thread).
 
 No jax imports (same rule as the metrics half).
 """
@@ -32,24 +53,55 @@ No jax imports (same rule as the metrics half).
 import json
 import threading
 import time
+from typing import NamedTuple
+
+from arena.obs.context import TraceContext
+from arena.obs import context as trace_context
+
+
+class SpanRecord(NamedTuple):
+    """One completed span as read back from the ring."""
+
+    name: str
+    start: float
+    duration: float
+    tid: int
+    span_id: int
+    parent_id: int  # 0 = root
+    trace_id: int
 
 
 class _Span:
-    """One live span: clock read on enter, row recorded on exit."""
+    """One live span: ids resolved + clock read on enter, row on exit."""
 
-    __slots__ = ("_tracer", "_name", "_t0")
+    __slots__ = ("_tracer", "_name", "_t0", "span_id", "parent_id",
+                 "trace_id")
 
     def __init__(self, tracer, name):
         self._tracer = tracer
         self._name = name
 
     def __enter__(self):
+        cur = trace_context.current()
+        self.span_id = self._tracer._new_span_id()
+        if cur is None:
+            self.trace_id = self._tracer.new_trace_id()
+            self.parent_id = 0
+        else:
+            self.trace_id = cur.trace_id
+            self.parent_id = cur.span_id
+        trace_context.push(TraceContext(self.trace_id, self.span_id))
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         t1 = time.perf_counter()
-        self._tracer.record_span(self._name, self._t0, t1 - self._t0)
+        trace_context.pop()
+        self._tracer.record_span(
+            self._name, self._t0, t1 - self._t0,
+            span_id=self.span_id, parent_id=self.parent_id,
+            trace_id=self.trace_id,
+        )
         return False
 
 
@@ -57,8 +109,11 @@ class Tracer:
     """Bounded ring buffer of completed spans.
 
     `capacity` rows are preallocated (name slots + float start/duration
-    arrays + int thread ids); recording wraps around, overwriting the
-    oldest row and incrementing `dropped` — newest-wins, fixed memory.
+    arrays + int thread/span/parent/trace ids); recording wraps around,
+    overwriting the oldest row and incrementing `dropped` —
+    newest-wins, fixed memory. Span and trace ids come from monotonic
+    counters that NEVER reset or wrap with the ring, so a parent link
+    stays meaningful after the parent's row is gone (see `orphans()`).
     All mutation happens under one small lock (a span record is a few
     list/scalar stores; contention is negligible next to the stages
     being traced).
@@ -72,8 +127,13 @@ class Tracer:
         self._starts = [0.0] * capacity
         self._durs = [0.0] * capacity
         self._tids = [0] * capacity
+        self._span_ids = [0] * capacity
+        self._parent_ids = [0] * capacity
+        self._trace_ids = [0] * capacity
         self._n = 0  # total ever recorded
         self.dropped = 0  # rows overwritten (n - capacity, floored at 0)
+        self._ids_allocated = 0  # span ids handed out, monotone forever
+        self._traces_allocated = 0  # trace ids handed out, monotone forever
         self._lock = threading.Lock()
 
     @property
@@ -81,28 +141,63 @@ class Tracer:
         """Total spans ever recorded (kept + dropped)."""
         return self._n
 
+    def new_trace_id(self):
+        """Allocate a fresh trace id (monotone, never reused)."""
+        with self._lock:
+            self._traces_allocated += 1
+            return self._traces_allocated
+
+    def _new_span_id(self):
+        with self._lock:
+            self._ids_allocated += 1
+            return self._ids_allocated
+
     def span(self, name):
-        """Context manager timing one named host stage."""
+        """Context manager timing one named host stage; nests under the
+        current thread-local context (or roots a fresh trace)."""
         return _Span(self, name)
 
-    def record_span(self, name, start, duration, tid=None):
+    def record_span(self, name, start, duration, tid=None, span_id=None,
+                    parent_id=None, trace_id=None, context=None):
         """Record one completed span (the non-context-manager form, for
         stages whose start/end cross function boundaries — e.g. the
-        pipeline's enqueue wait)."""
+        pipeline's enqueue wait — or zero-duration markers like
+        `pipeline.dropped`). Identity resolution, most explicit wins:
+        pass span/parent/trace ids outright (`_Span.__exit__` does), or
+        a `context=TraceContext(...)` to parent into a trace captured
+        elsewhere (how a dropped batch's trace gets its terminal
+        marker), or nothing — the thread-local context applies, and
+        with no context at all the span roots a fresh trace."""
         if tid is None:
             tid = threading.get_ident()
+        if span_id is None:
+            span_id = self._new_span_id()
+        if trace_id is None:
+            if context is None:
+                context = trace_context.current()
+            if context is not None:
+                trace_id = context.trace_id
+                parent_id = context.span_id
+            else:
+                trace_id = self.new_trace_id()
+                parent_id = 0
+        if parent_id is None:
+            parent_id = 0
         with self._lock:
             i = self._n % self.capacity
             self._names[i] = name
             self._starts[i] = start
             self._durs[i] = duration
             self._tids[i] = tid
+            self._span_ids[i] = span_id
+            self._parent_ids[i] = parent_id
+            self._trace_ids[i] = trace_id
             self._n += 1
             if self._n > self.capacity:
                 self.dropped += 1
 
     def spans(self):
-        """Kept spans, oldest first: (name, start_s, duration_s, tid)."""
+        """Kept spans as `SpanRecord`s, oldest first."""
         with self._lock:
             n = min(self._n, self.capacity)
             head = self._n % self.capacity
@@ -112,33 +207,127 @@ class Tracer:
                 else list(range(n))
             )
             return [
-                (self._names[i], self._starts[i], self._durs[i], self._tids[i])
+                SpanRecord(
+                    self._names[i], self._starts[i], self._durs[i],
+                    self._tids[i], self._span_ids[i], self._parent_ids[i],
+                    self._trace_ids[i],
+                )
                 for i in order
             ]
 
+    def trace(self, trace_id):
+        """Every kept span of ONE trace, oldest first — the read that
+        resolves a histogram exemplar's trace id into its request."""
+        return [r for r in self.spans() if r.trace_id == trace_id]
+
+    def orphans(self):
+        """Kept spans whose parent row is not in the ring, classified.
+
+        Returns `(record, reason)` pairs; `reason` is
+        ``"evicted-parent"`` when the parent id WAS allocated (its row
+        was overwritten — the ring's documented information loss, and
+        legitimate) or ``"dangling"`` when the id was never allocated
+        at all (a wiring bug; tier-1 asserts none exist at quiescence).
+        Roots (parent_id == 0) are never orphans. Meaningful at
+        quiescence: a parent span still OPEN (allocated, not yet
+        recorded) reads as evicted until it exits.
+        """
+        recs = self.spans()
+        kept = {r.span_id for r in recs}
+        with self._lock:
+            allocated = self._ids_allocated
+        out = []
+        for r in recs:
+            if r.parent_id and r.parent_id not in kept:
+                reason = (
+                    "evicted-parent"
+                    if 0 < r.parent_id <= allocated
+                    else "dangling"
+                )
+                out.append((r, reason))
+        return out
+
     def export_chrome_trace(self):
-        """Chrome trace-event list: complete ("X") events, microsecond
-        units, loadable by chrome://tracing and Perfetto."""
-        return [
-            {
-                "name": name,
-                "ph": "X",
-                "ts": round(start * 1e6, 3),
-                "dur": round(dur * 1e6, 3),
-                "pid": 0,
-                "tid": tid,
+        """Chrome trace-event list: complete ("X") events with span/
+        parent/trace ids in `args`, flow events ("s"/"f") for every
+        cross-thread parent→child edge, and one synthetic zero-duration
+        `evicted-parent` root per trace whose real root was overwritten
+        — loadable by chrome://tracing and Perfetto."""
+        recs = self.spans()
+        kept = {r.span_id: r for r in recs}
+        with self._lock:
+            allocated = self._ids_allocated
+        events = []
+        synthetic_rooted = set()
+        for r in recs:
+            args = {
+                "trace_id": r.trace_id,
+                "span_id": r.span_id,
+                "parent_id": r.parent_id,
             }
-            for name, start, dur, tid in self.spans()
-        ]
+            parent = kept.get(r.parent_id) if r.parent_id else None
+            if r.parent_id and parent is None:
+                reason = (
+                    "evicted-parent"
+                    if 0 < r.parent_id <= allocated
+                    else "dangling"
+                )
+                args["parent"] = reason
+                if reason == "evicted-parent" and r.trace_id not in synthetic_rooted:
+                    synthetic_rooted.add(r.trace_id)
+                    events.append({
+                        "name": "evicted-parent",
+                        "ph": "X",
+                        "ts": round(r.start * 1e6, 3),
+                        "dur": 0.0,
+                        "pid": 0,
+                        "tid": r.tid,
+                        "args": {"trace_id": r.trace_id,
+                                 "synthetic_root": True},
+                    })
+            events.append({
+                "name": r.name,
+                "ph": "X",
+                "ts": round(r.start * 1e6, 3),
+                "dur": round(r.duration * 1e6, 3),
+                "pid": 0,
+                "tid": r.tid,
+                "args": args,
+            })
+            if parent is not None and parent.tid != r.tid:
+                # Flow arrow: the producer-thread parent hands work to
+                # this thread (submit → pack, submit → dispatch).
+                events.append({
+                    "name": "trace", "cat": "trace", "ph": "s",
+                    "id": r.span_id,
+                    "ts": round(parent.start * 1e6, 3),
+                    "pid": 0, "tid": parent.tid,
+                })
+                events.append({
+                    "name": "trace", "cat": "trace", "ph": "f", "bp": "e",
+                    "id": r.span_id,
+                    "ts": round(r.start * 1e6, 3),
+                    "pid": 0, "tid": r.tid,
+                })
+        return events
 
     def export_chrome_trace_json(self):
         return json.dumps({"traceEvents": self.export_chrome_trace()})
 
 
 class _NullSpan:
-    """Singleton no-op context manager (zero allocation per span)."""
+    """Singleton no-op context manager (zero allocation per span).
+
+    Carries the id attributes of a real `_Span` as constant zeros so
+    instrumentation code can read `span.trace_id` unconditionally
+    (a zero trace id means "no trace" everywhere — histograms skip
+    exemplars for it)."""
 
     __slots__ = ()
+
+    span_id = 0
+    parent_id = 0
+    trace_id = 0
 
     def __enter__(self):
         return self
@@ -159,10 +348,20 @@ class NullTracer:
     def span(self, name):
         return self._SPAN
 
-    def record_span(self, name, start, duration, tid=None):
+    def new_trace_id(self):
+        return 0
+
+    def record_span(self, name, start, duration, tid=None, span_id=None,
+                    parent_id=None, trace_id=None, context=None):
         return None
 
     def spans(self):
+        return []
+
+    def trace(self, trace_id):
+        return []
+
+    def orphans(self):
         return []
 
     def export_chrome_trace(self):

@@ -50,6 +50,21 @@ thread boundary the double buffer was built for:
   The internal integer counters below remain the source of truth for
   `pending()`; the registry is the reporting schema.
 
+- **Causal tracing** (PR 7): every queue item carries the submitting
+  batch's `TraceContext` (captured from the engine's `batch.submit`
+  root span), and the packer/dispatcher re-attach it around their
+  work — so `pipeline.pack`, the CSR merge/compaction/staging inside
+  it, and `pipeline.dispatch` all parent into the SAME trace as the
+  submit, across the thread boundary (the Chrome export draws the
+  flow arrows). A batch that is shed instead of processed gets an
+  explicit terminal `pipeline.dropped` marker span in its trace —
+  a dropped request's trace ENDS, it never dangles. Submit-path
+  counters and the queue-depth gauge carry a `producer` label
+  (default "local"): the multi-producer front door (ROADMAP item 1)
+  lands on this schema instead of renaming metrics later. Drops,
+  spills, and queue-depth samples also land in the bounded
+  `Observability.event` log the flight recorder bundles.
+
 On this image's single host core the two threads share one CPU, so the
 overlap cannot beat the synchronous path in wall clock (the bench
 reports what it measures, with `host_cores` in the line); the
@@ -61,6 +76,8 @@ host needs, where device dispatch is idle host time the packer can use.
 import threading
 import time
 from collections import deque
+
+from arena.obs import context as trace_context
 
 POLICY_BLOCK = "block"
 POLICY_DROP_OLDEST = "drop-oldest"
@@ -89,17 +106,25 @@ class IngestPipeline:
     async ratings bit-exact to the sync ones.
     """
 
-    def __init__(self, engine, capacity=DEFAULT_QUEUE_CAPACITY, policy=POLICY_BLOCK):
+    def __init__(self, engine, capacity=DEFAULT_QUEUE_CAPACITY,
+                 policy=POLICY_BLOCK, producer="local"):
         if capacity < 1:
             raise ValueError(f"queue capacity must be >= 1, got {capacity}")
         if policy not in POLICIES:
             raise ValueError(f"unknown queue policy {policy!r}; pick one of {POLICIES}")
+        if not producer or not isinstance(producer, str):
+            raise ValueError(f"producer label must be a non-empty str, got {producer!r}")
         self._eng = engine
         self.capacity = capacity
         self.policy = policy
+        # Metric label for the submit path. One in-process producer
+        # today; ROADMAP item 1's multi-producer front door keys its
+        # per-producer streams by this label instead of renaming the
+        # counters/gauges later.
+        self.producer = producer
         self._cv = threading.Condition()
-        self._raw = deque()  # validated (winners, losers), not yet packed
-        self._ready = deque()  # staged PackedBatch, not yet dispatched
+        self._raw = deque()  # (winners, losers, trace ctx), not yet packed
+        self._ready = deque()  # (staged PackedBatch, trace ctx), not dispatched
         # Serializes pop-from-ready + apply so concurrent dispatchers
         # (submit draining while flush drains) keep FIFO order.
         self._dispatch_lock = threading.Lock()
@@ -132,16 +157,29 @@ class IngestPipeline:
         """Registry half of drop accounting: the internal ints above
         stay the source of truth for pending() (they are read under
         _cv as one consistent set), and every drop ALSO lands in the
-        registry as policy-labeled counters — the one schema
+        registry as policy+producer-labeled counters — the one schema
         `ArenaServer.stats()` and the soak bench report from. Counts
         survive pipeline restarts there, unlike these attributes."""
         obs = self._obs()
         obs.counter(
-            "arena_pipeline_dropped_batches_total", policy=self.policy
+            "arena_pipeline_dropped_batches_total", policy=self.policy,
+            producer=self.producer,
         ).inc(batches)
         obs.counter(
-            "arena_pipeline_dropped_matches_total", policy=self.policy
+            "arena_pipeline_dropped_matches_total", policy=self.policy,
+            producer=self.producer,
         ).inc(matches)
+        obs.event("drop", policy=self.policy, producer=self.producer,
+                  batches=batches, matches=matches)
+
+    def _end_dropped_trace(self, ctx):
+        """Terminal marker for a shed batch's trace: a zero-duration
+        `pipeline.dropped` span parented into the batch's own context,
+        so the trace ENDS with an explicit verdict instead of dangling
+        (tier-1 pins it under both backpressure policies)."""
+        self._obs().tracer.record_span(
+            "pipeline.dropped", time.perf_counter(), 0.0, context=ctx
+        )
 
     def pending(self):
         """Batches submitted but not yet dispatched (or dropped)."""
@@ -182,6 +220,7 @@ class IngestPipeline:
         caller dispatches ready work — backpressure can never deadlock
         against a packer waiting for a staging slot.
         """
+        ctx = trace_context.current()  # the batch.submit root (or None)
         wait_t0 = None
         while True:
             with self._cv:
@@ -189,15 +228,17 @@ class IngestPipeline:
                     raise PipelineError("pipeline is closed; start a new one")
                 self._raise_if_failed_locked()
                 if len(self._raw) < self.capacity:
-                    self._raw.append((winners, losers))
+                    self._raw.append((winners, losers, ctx))
                     self.submitted += 1
+                    depth = len(self._raw)
                     self._cv.notify_all()
                     break
                 if self.policy == POLICY_DROP_OLDEST:
-                    dw, _dl = self._raw.popleft()
+                    dw, _dl, dctx = self._raw.popleft()
                     self.dropped_batches += 1
                     self.dropped_matches += int(dw.shape[0])
                     self._count_dropped(1, int(dw.shape[0]))
+                    self._end_dropped_trace(dctx)
                     continue
                 self._check_packer_locked()
             if wait_t0 is None:
@@ -208,12 +249,21 @@ class IngestPipeline:
             if not self._dispatch_one():
                 with self._cv:
                     self._cv.wait(_WAIT_S)
+        obs = self._obs()
+        obs.counter(
+            "arena_pipeline_submitted_batches_total", producer=self.producer
+        ).inc()
+        obs.gauge(
+            "arena_pipeline_queue_depth", producer=self.producer
+        ).set(float(depth))
+        obs.event("queue_depth", depth=depth, producer=self.producer)
         if wait_t0 is not None:
             # Backpressure made this submit wait (dispatching ready
             # work counts as waiting: the caller could not enqueue).
             waited = time.perf_counter() - wait_t0
-            obs = self._obs()
-            obs.histogram("arena_pipeline_enqueue_wait_seconds").record(waited)
+            obs.histogram(
+                "arena_pipeline_enqueue_wait_seconds", producer=self.producer
+            ).record(waited)
             obs.tracer.record_span("pipeline.enqueue_wait", wait_t0, waited)
         # Overlap: opportunistically dispatch whatever the packer has
         # already staged while the caller is here anyway.
@@ -228,10 +278,14 @@ class IngestPipeline:
             with self._cv:
                 if not self._ready:
                     return False
-                packed = self._ready.popleft()
+                packed, ctx = self._ready.popleft()
             t0 = time.perf_counter()
             try:
-                with self._obs().span("pipeline.dispatch"):
+                # Re-attach the batch's own context: whichever thread
+                # happens to dispatch, the span parents into the
+                # SUBMITTING batch's trace, not the current caller's.
+                with trace_context.attach(ctx), \
+                        self._obs().span("pipeline.dispatch"):
                     self._eng._dispatch_packed(packed)
             finally:
                 self.dispatch_s += time.perf_counter() - t0
@@ -277,26 +331,32 @@ class IngestPipeline:
             self._closed = True
             if spill:
                 while self._raw:
-                    sw, sl = self._raw.popleft()
+                    sw, sl, _sctx = self._raw.popleft()
                     self.spilled_batches += 1
                     self.spilled_matches += int(sw.shape[0])
                     spilled.append((sw, sl))
                 if spilled:
                     obs = self._obs()
-                    obs.counter("arena_pipeline_spilled_batches_total").inc(
-                        len(spilled)
-                    )
-                    obs.counter("arena_pipeline_spilled_matches_total").inc(
-                        self.spilled_matches
-                    )
+                    obs.counter(
+                        "arena_pipeline_spilled_batches_total",
+                        producer=self.producer,
+                    ).inc(len(spilled))
+                    obs.counter(
+                        "arena_pipeline_spilled_matches_total",
+                        producer=self.producer,
+                    ).inc(self.spilled_matches)
+                    obs.event("spill", producer=self.producer,
+                              batches=len(spilled),
+                              matches=self.spilled_matches)
             elif not drain:
                 dropped_b = dropped_m = 0
                 while self._raw:
-                    dw, _dl = self._raw.popleft()
+                    dw, _dl, dctx = self._raw.popleft()
                     self.dropped_batches += 1
                     self.dropped_matches += int(dw.shape[0])
                     dropped_b += 1
                     dropped_m += int(dw.shape[0])
+                    self._end_dropped_trace(dctx)
                 if dropped_b:
                     self._count_dropped(dropped_b, dropped_m)
             self._cv.notify_all()
@@ -317,12 +377,17 @@ class IngestPipeline:
                     self._cv.wait()
                 if not self._raw:
                     return  # closed and fully drained
-                w, l = self._raw.popleft()
+                w, l, ctx = self._raw.popleft()
                 self._packing = True
                 self._cv.notify_all()  # queue space for blocked submits
             try:
                 t0 = time.perf_counter()
-                with self._obs().span("pipeline.pack"):
+                # Adopt the submitting batch's trace on THIS thread:
+                # the pack span (and the CSR merge/compaction/staging
+                # spans inside it) parent into the producer's
+                # batch.submit root across the thread boundary.
+                with trace_context.attach(ctx), \
+                        self._obs().span("pipeline.pack"):
                     packed = self._eng._pack_for_pipeline(w, l)
                 self.host_pack_s += time.perf_counter() - t0
             except BaseException as exc:  # noqa: BLE001 — must surface on the caller
@@ -333,17 +398,20 @@ class IngestPipeline:
                     # dropped; flush()/submit() re-raise on next call.
                     dropped_b = 1 + len(self._raw)
                     dropped_m = int(w.shape[0]) + sum(
-                        int(rw.shape[0]) for rw, _rl in self._raw
+                        int(rw.shape[0]) for rw, _rl, _rc in self._raw
                     )
                     self.dropped_batches += dropped_b
                     self.dropped_matches += dropped_m
                     self._count_dropped(dropped_b, dropped_m)
+                    self._end_dropped_trace(ctx)
+                    for _rw, _rl, rctx in self._raw:
+                        self._end_dropped_trace(rctx)
                     self._raw.clear()
                     self._cv.notify_all()
                 return
             with self._cv:
                 if packed is not None:
-                    self._ready.append(packed)
+                    self._ready.append((packed, ctx))
                 else:
                     self.completed += 1  # empty batch: nothing to dispatch
                 self._packing = False

@@ -573,6 +573,15 @@ class ArenaServer:
         watermark, its staleness at serve time, and the stale flag.
         """
         t0 = time.perf_counter()
+        # Root span: this query's trace id — the view build (when this
+        # query triggers one) nests under it, the latency/staleness
+        # histograms record it as the bucket exemplar, and
+        # `obs.tracer.trace(id)` replays the whole request afterwards.
+        with self.obs.span("serve.query") as qspan:
+            out = self._query_into(qspan, t0, leaderboard, players, pairs)
+        return out
+
+    def _query_into(self, qspan, t0, leaderboard, players, pairs):
         view, stale = self._serve_view()
         self._c_queries.inc()
         num_players = view.ratings.size
@@ -626,10 +635,11 @@ class ArenaServer:
         # soak bench (and any future network tier) reports. Host-side
         # work only between the clock reads — every value served came
         # from the prebuilt host view, nothing here awaits a device.
+        # The trace id rides into each bucket as its exemplar: "show me
+        # the trace behind the p99 bucket" resolves via tracer.trace().
         latency = time.perf_counter() - t0
-        self._h_query_latency.record(latency)
-        self._h_staleness.record(out["staleness"])
-        self.obs.tracer.record_span("serve.query", t0, latency)
+        self._h_query_latency.record(latency, trace_id=qspan.trace_id)
+        self._h_staleness.record(out["staleness"], trace_id=qspan.trace_id)
         return out
 
     def _player_row(self, view, p, rank=None):

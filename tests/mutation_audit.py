@@ -59,6 +59,7 @@ COPIED = (
     "verify_reference.py",
     "reference_fingerprint.json",
     "BASELINE.json",
+    "BENCH_BASELINE.json",
     "PAPERS.md",
     "SNIPPETS.md",
     "pytest.ini",
@@ -391,6 +392,48 @@ MUTATIONS = (
         "must exit rc 2, never rc 0); the full block is covered so no "
         "single surviving condition can mask another (the lesson the "
         "pipeline gate mutant already taught)",
+    ),
+    (
+        "obs-exemplar-recorded-into-wrong-bucket",
+        "arena/obs/metrics.py",
+        "            if trace_id:\n"
+        "                self._ex_trace[idx] = trace_id\n"
+        "                self._ex_value[idx] = value",
+        "            if trace_id:\n"
+        "                self._ex_trace[0] = trace_id\n"
+        "                self._ex_value[0] = value",
+        "a latency exemplar must land in the bucket its value belongs to "
+        "(the same le-semantics index the count uses); pinned to bucket 0, "
+        "'show me the trace behind the p99 bucket' silently answers with an "
+        "arbitrary fast request's trace — killed by "
+        "test_exemplar_lands_in_recorded_values_bucket",
+    ),
+    (
+        "obs-debug-bundle-omits-registry-dump",
+        "arena/obs/debug.py",
+        '    (tmp / "metrics.json").write_text(\n'
+        "        json.dumps(obs.registry.dump(), indent=1, sort_keys=True)\n"
+        "    )",
+        "    pass",
+        "the flight recorder's bundle must carry the full registry dump — "
+        "a postmortem without the counters/histograms that fired the gate "
+        "is a bundle-shaped empty box — killed by "
+        "test_debug_bundle_contains_registry_dump",
+    ),
+    (
+        "obs-watchdog-tolerance-inverted",
+        "arena/obs/regress.py",
+        '    if direction == "higher":\n'
+        "        return value < base * (1.0 - tol)\n"
+        "    return value > base * (1.0 + tol)",
+        '    if direction == "higher":\n'
+        "        return value > base * (1.0 + tol)\n"
+        "    return value < base * (1.0 - tol)",
+        "the watchdog's tolerance comparison must flag the BAD side of the "
+        "band: inverted, a 20% throughput regression exits rc 0 while every "
+        "improvement exits rc 1 — the bench trajectory gate becomes "
+        "actively misleading — killed by "
+        "test_watchdog_flags_regressions_not_improvements",
     ),
     (
         "lint-donation-poisoning-dropped",

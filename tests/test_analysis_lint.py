@@ -87,6 +87,7 @@ def test_default_targets_cover_the_ingest_and_pipeline_modules():
     for mod in (
         "ingest.py", "pipeline.py", "serving.py",
         "obs/__init__.py", "obs/metrics.py", "obs/tracing.py",
+        "obs/context.py", "obs/debug.py", "obs/regress.py",
     ):
         path = str(REPO / "arena" / mod)
         assert path in walked, f"default targets no longer cover arena/{mod}"
@@ -114,6 +115,20 @@ def test_obs_span_api_does_not_trip_the_timing_rule():
         "    return y\n"
     )
     assert jaxlint.lint_source(instrumented, "ok.py") == []
+    # Trace-context propagation carries IDS, it does not time device
+    # work: an attach-wrapped cross-thread dispatch (the pipeline's
+    # packer shape) must not trip the timing rule either.
+    carried = (
+        "import jax.numpy as jnp\n"
+        "from arena.obs import Observability, attach\n"
+        "obs = Observability()\n"
+        "def pack_on_worker(ctx, x):\n"
+        "    with attach(ctx):\n"
+        "        with obs.span('pipeline.pack'):\n"
+        "            y = jnp.dot(x, x)\n"
+        "    return y\n"
+    )
+    assert jaxlint.lint_source(carried, "ok_ctx.py") == []
 
 
 def test_sharding_spec_rule_flags_both_failure_modes():

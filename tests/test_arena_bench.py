@@ -53,8 +53,12 @@ def assert_contract(result):
     )
 
 
-def test_bench_smoke_contract_and_speedup():
-    """Fast-path version of the acceptance comparison (tier-1)."""
+def test_bench_smoke_contract_and_speedup(tmp_path):
+    """Fast-path version of the acceptance comparison (tier-1) — also
+    pins the watchdog history contract: with ARENA_BENCH_HISTORY set,
+    the emitted line is APPENDED verbatim to the JSON Lines file
+    `python -m arena.obs.regress` reads."""
+    history = tmp_path / "hist.jsonl"
     result = run_bench(
         {
             "ARENA_BENCH_MATCHES": "2000",
@@ -62,9 +66,12 @@ def test_bench_smoke_contract_and_speedup():
             "ARENA_BENCH_BATCH": "512",
             "ARENA_BENCH_REPEATS": "3",
             "ARENA_BENCH_BT_ITERS": "5",
+            "ARENA_BENCH_HISTORY": str(history),
         }
     )
     assert_contract(result)
+    lines = history.read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0]) == result
     assert result["params"]["num_matches"] == 2000
     # Even at smoke size (where fixed dispatch overhead is at its most
     # punishing relative to work), vectorized must beat the loop.
@@ -128,12 +135,19 @@ def test_ingest_bench_smoke_contract():
     assert result["obs"]["spans_recorded"] > 0
 
 
-def test_ingest_bench_equivalence_gate_extends_to_incremental_path():
+def test_ingest_bench_equivalence_gate_extends_to_incremental_path(tmp_path):
     """The hard gate on the INCREMENTAL path: forcing the chunked-vs-
     single BT tolerance to 0 must emit the distinct equivalence-failure
-    line (ingest-mode unit, no speedup fields) and exit rc 2."""
+    line (ingest-mode unit, no speedup fields) and exit rc 2 — and,
+    since PR 7, ship a flight-recorder bundle path next to the verdict
+    (registry dump + Chrome trace, the postmortem evidence)."""
     result = run_bench(
-        {**INGEST_SMOKE_ENV, "ARENA_BENCH_BT_TOL": "0"}, expect_rc=2
+        {
+            **INGEST_SMOKE_ENV,
+            "ARENA_BENCH_BT_TOL": "0",
+            "ARENA_DEBUG_DIR": str(tmp_path),
+        },
+        expect_rc=2,
     )
     assert result["metric"] == "arena_bench_equivalence_failure"
     assert result["value"] == -1
@@ -141,6 +155,12 @@ def test_ingest_bench_equivalence_gate_extends_to_incremental_path():
     assert result["tolerance"] == 0.0
     assert "exceeds tolerance" in result["error"]
     assert "ingest" not in result and "bt" not in result
+    bundle = pathlib.Path(result["debug_bundle"])
+    assert bundle.parent == tmp_path
+    assert (bundle / "metrics.json").exists()
+    assert (bundle / "trace.json").exists()
+    metrics = json.loads((bundle / "metrics.json").read_text())
+    assert metrics["counters"], "bundle registry dump must carry counters"
 
 
 @pytest.mark.slow
@@ -211,6 +231,8 @@ def test_pipeline_bench_equivalence_gate_extends_to_async_path():
     assert result["tolerance"] == 0.0
     assert "exceeds tolerance" in result["error"]
     assert "pipeline" not in result and "bt" not in result
+    # The rc-2 line ships a flight-recorder bundle (instrumented mode).
+    assert result["debug_bundle"] is not None
 
 
 @pytest.mark.slow
@@ -286,6 +308,7 @@ def test_serve_bench_equivalence_gate_is_hard():
     assert result["tolerance"] == 0.0
     assert "exceeds tolerance" in result["error"]
     assert "serve" not in result and "bt" not in result
+    assert result["debug_bundle"] is not None
 
 
 @pytest.mark.slow
@@ -341,6 +364,11 @@ def test_soak_bench_smoke_contract():
     assert soak["donation_skipped"] == 0
     assert soak["dropped_batches"] == 0
     assert soak["trace_spans_recorded"] > 0
+    # Causal diagnosis held through the soak: every span chains to a
+    # root (zero DANGLING orphans), and the p99 query-latency bucket
+    # carries a resolvable exemplar trace id.
+    assert soak["trace_dangling_orphans"] == 0
+    assert soak["p99_exemplar"]["trace_id"] > 0
     assert soak["max_view_mass_dev"] < 0.5
     assert result["params"]["max_staleness_matches"] == 2000
 
@@ -360,6 +388,7 @@ def test_soak_bench_gate_is_hard():
     assert result["tolerance"] == 0.0
     assert "exceeds tolerance" in result["error"]
     assert "soak" not in result
+    assert result["debug_bundle"] is not None
 
 
 @pytest.mark.slow
@@ -401,6 +430,8 @@ def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     assert "exceeds tolerance" in result["error"]
     # The line must not smuggle a speedup or per-path timings along.
     assert "elo" not in result and "bt" not in result and "sharded" not in result
+    # elo mode runs uninstrumented: no flight to record, honest null.
+    assert result["debug_bundle"] is None
 
 
 def test_bench_internal_error_degrades_to_error_line():

@@ -59,8 +59,11 @@ def test_mutations_cover_every_policed_surface():
     since PR 4 the overlapped pipeline (packer liveness) plus the
     arena bench's async equivalence gate, since PR 5 the serving
     layer (silent-partial-restore, staleness policy, snapshot version
-    gate), and since PR 6 the observability layer (histogram bucket
-    semantics, stats() sentinel absorption, the soak hard gate)."""
+    gate), since PR 6 the observability layer (histogram bucket
+    semantics, stats() sentinel absorption, the soak hard gate), and
+    since PR 7 the diagnosis layer (exemplar bucket placement, the
+    flight recorder's registry dump, the watchdog's tolerance
+    direction)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -71,6 +74,8 @@ def test_mutations_cover_every_policed_surface():
         "arena/serving.py",
         "arena/bench_arena.py",
         "arena/obs/metrics.py",
+        "arena/obs/debug.py",
+        "arena/obs/regress.py",
     }
 
 
@@ -101,6 +106,8 @@ def _fake_sources_only(dest):
         "arena/serving.py",
         "arena/bench_arena.py",
         "arena/obs/metrics.py",
+        "arena/obs/debug.py",
+        "arena/obs/regress.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)

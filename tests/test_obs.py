@@ -135,17 +135,25 @@ def test_trace_ring_overflow_keeps_newest_and_counts_drops():
         tr.record_span(f"s{i}", float(i), 0.5)
     assert tr.dropped == 12
     assert tr.recorded == 20
-    kept = [name for name, _t0, _dur, _tid in tr.spans()]
+    kept = [r.name for r in tr.spans()]
     assert kept == [f"s{i}" for i in range(12, 20)]  # newest 8, in order
+    # Span ids are MONOTONIC and never reset with the ring: wraparound
+    # keeps allocation order intact (the evicted-parent classification
+    # in orphans() stands on this).
+    ids = [r.span_id for r in tr.spans()]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    assert min(ids) > 8  # the evicted rows' ids are NOT reused
 
 
 def test_span_context_manager_records_duration_and_thread():
     tr = Tracer(capacity=8)
     with tr.span("work"):
         pass
-    [(name, start, dur, tid)] = tr.spans()
-    assert name == "work" and dur >= 0.0 and start > 0.0
-    assert tid == threading.get_ident()
+    [rec] = tr.spans()
+    assert rec.name == "work" and rec.duration >= 0.0 and rec.start > 0.0
+    assert rec.tid == threading.get_ident()
+    # A context-less span is the ROOT of its own fresh trace.
+    assert rec.parent_id == 0 and rec.trace_id > 0 and rec.span_id > 0
 
 
 def test_chrome_trace_export_shape():
@@ -257,11 +265,14 @@ def test_pipeline_drop_counters_land_in_registry_policy_labeled():
             eng.ingest_async(*batch)  # capacity 2: two oldest raw drop
     eng.flush()
     assert pipe.dropped_batches == 2
+    # Producer-labeled since PR 7 (defaults to "local"): the
+    # multi-producer front door lands on this schema, not a rename.
     c = o.registry.counter("arena_pipeline_dropped_batches_total",
-                           policy="drop-oldest")
+                           policy="drop-oldest", producer="local")
     assert c.value == 2
     assert o.registry.counter(
-        "arena_pipeline_dropped_matches_total", policy="drop-oldest"
+        "arena_pipeline_dropped_matches_total", policy="drop-oldest",
+        producer="local",
     ).value == 40
     assert o.registry.counter_sum("arena_pipeline_dropped_batches_total") == 2
     assert {"pipeline.pack", "pipeline.dispatch"} <= {

@@ -1,0 +1,425 @@
+"""Causal tracing contracts: trace-context propagation, exemplars,
+and the flight recorder (arena/obs/context.py, tracing ids,
+metrics exemplars, arena/obs/debug.py).
+
+The load-bearing properties:
+
+- spans form TREES: nesting on one thread links parent→child; a
+  context shipped across the pipeline queue links the packer/dispatch
+  spans back to the producer's `batch.submit` root (block AND
+  drop-oldest policies);
+- a dropped batch's trace ENDS with an explicit `pipeline.dropped`
+  marker — never a dangling chain;
+- span ids are monotonic and survive ring wraparound; a kept child
+  whose parent row was evicted classifies as `evicted-parent` (a
+  documented information loss), never as `dangling` (a bug) — and the
+  Chrome export re-roots it under a synthetic `evicted-parent` event;
+- histogram exemplars land in the recorded value's OWN bucket (the
+  mutation audit carries a wrong-bucket mutant;
+  test_exemplar_lands_in_recorded_values_bucket is its named kill) and
+  stay bucket-consistent under N concurrent recording threads;
+- in a mini soak (async ingest + queries + snapshot) every recorded
+  span is reachable from a root, zero dangling orphans, and the p99
+  query-latency exemplar resolves to a real recorded trace — the
+  ISSUE 8 acceptance criterion, tier-1-sized;
+- `dump_debug_bundle` writes one complete, atomic postmortem directory
+  (the audit carries an omits-registry-dump mutant;
+  test_debug_bundle_contains_registry_dump is its named kill).
+"""
+
+import json
+import threading
+
+import numpy as np
+
+from arena import obs as obs_pkg
+from arena.engine import ArenaEngine
+from arena.obs import TraceContext
+from arena.obs.debug import dump_debug_bundle
+from arena.obs.metrics import Histogram, Registry
+from arena.obs.tracing import Tracer
+from arena.serving import ArenaServer
+
+P = 40
+
+
+def make_matches(n, num_players=P, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, num_players, n).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, num_players - 1, n)) % num_players).astype(
+        np.int32
+    )
+    return a, b
+
+
+def _by_name(recs, name):
+    return [r for r in recs if r.name == name]
+
+
+# --- in-thread span trees ---------------------------------------------------
+
+
+def test_nested_spans_link_parent_child_in_one_trace():
+    tr = Tracer(capacity=32)
+    with tr.span("root") as root:
+        with tr.span("mid") as mid:
+            with tr.span("leaf"):
+                pass
+    recs = {r.name: r for r in tr.spans()}
+    assert recs["root"].parent_id == 0
+    assert recs["mid"].parent_id == recs["root"].span_id
+    assert recs["leaf"].parent_id == recs["mid"].span_id
+    assert (
+        recs["root"].trace_id
+        == recs["mid"].trace_id
+        == recs["leaf"].trace_id
+        == root.trace_id
+        == mid.trace_id
+    )
+    # Two sibling roots get DISTINCT traces.
+    with tr.span("other"):
+        pass
+    other = _by_name(tr.spans(), "other")[0]
+    assert other.trace_id != root.trace_id and other.parent_id == 0
+
+
+def test_attach_adopts_a_foreign_context_and_none_is_noop():
+    tr = Tracer(capacity=32)
+    with tr.span("producer") as prod:
+        ctx = obs_pkg.current_context()
+        assert ctx == TraceContext(prod.trace_id, prod.span_id)
+    # Another "thread" (same thread, empty stack) attaches the context.
+    assert obs_pkg.current_context() is None
+    with obs_pkg.attach(ctx):
+        with tr.span("consumer"):
+            pass
+    with obs_pkg.attach(None):  # the null path: explicit no-op
+        assert obs_pkg.current_context() is None
+    consumer = _by_name(tr.spans(), "consumer")[0]
+    assert consumer.trace_id == prod.trace_id
+    assert consumer.parent_id == prod.span_id
+
+
+def test_trace_returns_exactly_one_requests_spans():
+    tr = Tracer(capacity=32)
+    with tr.span("a"):
+        with tr.span("a.child"):
+            pass
+    with tr.span("b"):
+        pass
+    a_root = _by_name(tr.spans(), "a")[0]
+    names = {r.name for r in tr.trace(a_root.trace_id)}
+    assert names == {"a", "a.child"}
+
+
+# --- wraparound, monotonic ids, orphan classification -----------------------
+
+
+def test_evicted_parent_is_classified_not_dangling():
+    """Children recorded AFTER their root (the pipeline's dispatch
+    shape) survive the root's eviction: monotonic ids classify the
+    missing parent as `evicted-parent`, and the Chrome export re-roots
+    them under a synthetic event instead of leaving dangling ids."""
+    tr = Tracer(capacity=4)
+    with tr.span("root") as root:
+        pass
+    ctx = TraceContext(root.trace_id, root.span_id)
+    for i in range(6):  # evicts the root's row; ids keep growing
+        tr.record_span(f"late{i}", float(i), 0.1, context=ctx)
+    kept = {r.span_id for r in tr.spans()}
+    assert root.span_id not in kept  # the root really was evicted
+    orphaned = tr.orphans()
+    assert orphaned, "evicted root must orphan its late children"
+    assert all(reason == "evicted-parent" for _r, reason in orphaned)
+    events = tr.export_chrome_trace()
+    synthetic = [e for e in events if e["name"] == "evicted-parent"]
+    assert len(synthetic) == 1  # one synthetic root per affected trace
+    assert synthetic[0]["args"]["synthetic_root"] is True
+    marked = [
+        e for e in events
+        if e.get("args", {}).get("parent") == "evicted-parent"
+    ]
+    assert len(marked) == len(orphaned)
+
+
+def test_never_allocated_parent_id_is_dangling():
+    tr = Tracer(capacity=8)
+    tr.record_span("bad", 0.0, 0.1, context=TraceContext(1, 999))
+    [(rec, reason)] = tr.orphans()
+    assert rec.name == "bad" and reason == "dangling"
+
+
+# --- cross-thread propagation through the pipeline --------------------------
+
+
+def test_trace_context_rides_pipeline_queue_block_policy():
+    """One async batch's full chain — submit (producer thread) → pack/
+    CSR merge (packer thread) → dispatch (producer thread again) —
+    reconstructs as ONE tree from the ring, flow events included."""
+    o = obs_pkg.Observability()
+    eng = ArenaEngine(P, obs=o)
+    eng.start_pipeline(capacity=4)  # block policy (default)
+    w, l = make_matches(300, seed=1)
+    eng.ingest_async(w, l)
+    eng.flush()
+    eng.shutdown()
+    recs = o.tracer.spans()
+    [root] = _by_name(recs, "batch.submit")
+    [pack] = _by_name(recs, "pipeline.pack")
+    [disp] = _by_name(recs, "pipeline.dispatch")
+    [merge] = _by_name(recs, "ingest.csr_merge")
+    assert root.parent_id == 0
+    assert pack.trace_id == disp.trace_id == merge.trace_id == root.trace_id
+    assert pack.parent_id == root.span_id
+    assert disp.parent_id == root.span_id
+    # The merge ran INSIDE the pack span, on the packer thread.
+    assert merge.parent_id == pack.span_id
+    assert merge.tid == pack.tid != root.tid
+    # engine.apply nests under the dispatch.
+    [apply_rec] = _by_name(recs, "engine.apply")
+    assert apply_rec.parent_id == disp.span_id
+    # The Chrome export draws flow arrows for the cross-thread edges.
+    events = o.tracer.export_chrome_trace()
+    flow_ids = {e["id"] for e in events if e.get("ph") in ("s", "f")}
+    assert pack.span_id in flow_ids
+    # Dangling-free at quiescence.
+    assert [r for r, why in o.tracer.orphans() if why == "dangling"] == []
+
+
+def test_dropped_batch_trace_ends_with_dropped_marker():
+    """Drop-oldest shedding: the two dropped batches' traces END with
+    an explicit `pipeline.dropped` span parented into their own
+    `batch.submit` roots — and those traces never grew pack/dispatch
+    spans. The surviving batches' traces completed normally."""
+    o = obs_pkg.Observability()
+    eng = ArenaEngine(P, obs=o)
+    pipe = eng.start_pipeline(capacity=2, policy="drop-oldest")
+    w, l = make_matches(100, seed=2)
+    batches = [
+        (w[i * 20:(i + 1) * 20], l[i * 20:(i + 1) * 20]) for i in range(5)
+    ]
+    with eng._store._lock:  # stall the packer inside its first merge
+        eng.ingest_async(*batches[0])
+        waited = 0
+        while not pipe._packing and waited < 2000:
+            waited += 1
+            threading.Event().wait(0.005)
+        assert pipe._packing
+        for batch in batches[1:]:
+            eng.ingest_async(*batch)  # capacity 2: two oldest raw drop
+    eng.flush()
+    eng.shutdown()
+    recs = o.tracer.spans()
+    roots = _by_name(recs, "batch.submit")
+    assert len(roots) == 5
+    dropped = _by_name(recs, "pipeline.dropped")
+    assert len(dropped) == 2
+    dropped_traces = {r.trace_id for r in dropped}
+    for marker in dropped:
+        [root] = [r for r in roots if r.trace_id == marker.trace_id]
+        assert marker.parent_id == root.span_id
+        # A shed batch was never packed or dispatched: the marker is
+        # the trace's TERMINAL span, not a detour.
+        trace_names = {r.name for r in o.tracer.trace(marker.trace_id)}
+        assert trace_names == {"batch.submit", "pipeline.dropped"}
+    # The surviving batches packed and dispatched under their roots:
+    # parent ids survive the queue under drop-oldest exactly as under
+    # block.
+    for r in _by_name(recs, "pipeline.pack") + _by_name(
+        recs, "pipeline.dispatch"
+    ):
+        assert r.trace_id not in dropped_traces
+        [root] = [x for x in roots if x.trace_id == r.trace_id]
+        assert r.parent_id == root.span_id
+    assert len(_by_name(recs, "pipeline.pack")) == 3
+    assert [r for r, why in o.tracer.orphans() if why == "dangling"] == []
+
+
+def test_producer_label_defaults_local_and_is_overridable():
+    o = obs_pkg.Observability()
+    eng = ArenaEngine(P, obs=o)
+    eng.start_pipeline(capacity=4)
+    w, l = make_matches(60, seed=3)
+    eng.ingest_async(w, l)
+    eng.flush()
+    eng.shutdown()
+    reg = o.registry
+    assert reg.counter(
+        "arena_pipeline_submitted_batches_total", producer="local"
+    ).value == 1
+    assert reg.gauge(
+        "arena_pipeline_queue_depth", producer="local"
+    ).value >= 0.0
+    # An explicit producer label lands on the SAME metric names.
+    eng2 = ArenaEngine(P, obs=o)
+    eng2.start_pipeline(capacity=4, producer="frontend-7")
+    eng2.ingest_async(w, l)
+    eng2.flush()
+    eng2.shutdown()
+    assert reg.counter(
+        "arena_pipeline_submitted_batches_total", producer="frontend-7"
+    ).value == 1
+    assert reg.counter_sum("arena_pipeline_submitted_batches_total") == 2
+    # Queue-depth samples reached the flight-recorder event log too.
+    assert any(e["kind"] == "queue_depth" for e in o.events)
+
+
+# --- exemplars --------------------------------------------------------------
+
+
+def test_exemplar_lands_in_recorded_values_bucket():
+    """A traced record stores its (trace_id, value) exemplar IN THE
+    VALUE'S OWN BUCKET — `exemplar(q)` then answers "the trace behind
+    that quantile". The mutation audit carries a wrong-bucket mutant;
+    this is its named kill."""
+    h = Histogram("t", {}, base=1e-3, num_buckets=8)
+    v = 1e-3 * 2.0**3  # exactly on bound 3 -> bucket 3 (le semantics)
+    h.record(v, trace_id=77)
+    assert h.exemplars() == [(3, 77, v)]
+    ex = h.exemplar(0.5)  # the only observation: quantile bucket is 3
+    assert ex == {"trace_id": 77, "value": v, "bucket_index": 3}
+    # Untraced records store nothing; empty buckets answer None.
+    h2 = Histogram("t2", {}, base=1e-3, num_buckets=8)
+    h2.record(v)
+    assert h2.exemplars() == [] and h2.exemplar(0.5) is None
+    # The snapshot and render expose the exemplar alongside the bucket.
+    snap = h.snapshot()
+    assert snap["exemplars"] == {"0.008": {"trace_id": 77, "value": v}}
+    reg = Registry()
+    reg._metrics[("t", ())] = h
+    assert '# {trace_id="77"}' in reg.render()
+
+
+def test_exemplars_stay_bucket_consistent_under_concurrent_observes():
+    """N threads hammering one histogram with traced values: counts
+    stay exact AND every stored exemplar's value belongs to the bucket
+    it sits in (no torn trace/value pair can cross buckets)."""
+    h = Histogram("lat", {}, base=1.0, num_buckets=16)
+    threads, per_thread = 8, 500
+
+    def worker(tid):
+        for i in range(per_thread):
+            v = float(2 ** (i % 10)) * (1.0 + 0.25 * (tid % 3))
+            h.record(v, trace_id=tid * 100_000 + i + 1)
+
+    workers = [
+        threading.Thread(target=worker, args=(t,)) for t in range(threads)
+    ]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=60.0)
+    assert h.count == threads * per_thread
+    exs = h.exemplars()
+    assert exs, "traced records must leave exemplars"
+    for bucket_idx, trace_id, value in exs:
+        assert h.bucket_index(value) == bucket_idx
+        assert trace_id > 0
+
+
+# --- the acceptance criterion, tier-1-sized ---------------------------------
+
+
+def test_mini_soak_all_spans_reachable_and_p99_exemplar_resolves(tmp_path):
+    """A mixed workload (async ingest + queries + snapshot): every
+    recorded span is reachable from a root via kept parents (zero
+    orphans modulo the explicit evicted-parent/dropped markers — none
+    of either here, the ring is large), and the p99 query-latency
+    bucket's exemplar trace id resolves to a real recorded trace whose
+    root is a `serve.query` span."""
+    o = obs_pkg.Observability()
+    srv = ArenaServer(num_players=P, max_staleness_matches=0, obs=o)
+    eng = srv.engine
+    w, l = make_matches(2600, seed=4)
+    eng.ingest(w[:1000], l[:1000])
+    eng.start_pipeline(capacity=4)
+    for i in range(8):
+        s = 1000 + i * 200
+        eng.ingest_async(w[s:s + 200], l[s:s + 200])
+        srv.query(leaderboard=(0, 5), players=[0, 1], pairs=[(0, 1)])
+    eng.flush()
+    srv.snapshot(tmp_path / "snap")
+    srv.query(leaderboard=(0, 5))
+    eng.shutdown()
+    recs = o.tracer.spans()
+    assert recs and all(r.trace_id > 0 for r in recs)
+    # Zero orphans of EITHER kind: the ring held everything, so every
+    # parent chain walks up to a root inside the ring.
+    assert o.tracer.orphans() == []
+    by_id = {r.span_id: r for r in recs}
+    root_names = set()
+    for r in recs:
+        cur, hops = r, 0
+        while cur.parent_id:
+            cur = by_id[cur.parent_id]
+            hops += 1
+            assert hops <= len(recs), "parent cycle"
+        root_names.add(cur.name)
+        assert cur.trace_id == r.trace_id  # chains never cross traces
+    # Every root is an intentional request/operation entry point.
+    assert root_names <= {
+        "batch.submit", "batch.ingest", "batch.update", "serve.query",
+        "serve.snapshot", "serve.view_build",
+    }
+    assert {"batch.submit", "batch.ingest", "serve.query"} <= root_names
+    # The p99 exemplar: a real trace id, resolving to a real query
+    # trace (its root is the serve.query span that recorded it).
+    h = o.registry.histogram("arena_query_latency_seconds")
+    ex = h.exemplar(0.99)
+    assert ex is not None and ex["trace_id"] > 0
+    trace = o.tracer.trace(ex["trace_id"])
+    assert trace, "exemplar trace id must resolve to recorded spans"
+    assert any(r.name == "serve.query" and r.parent_id == 0 for r in trace)
+
+
+# --- the flight recorder ----------------------------------------------------
+
+
+def test_debug_bundle_contains_registry_dump(tmp_path):
+    """The bundle carries ALL four evidence files; metrics.json is the
+    full registry dump (the audit carries an omits-registry-dump
+    mutant; this is its named kill), trace.json the Chrome export, and
+    events.json the recent events with the queue-depth timeline."""
+    o = obs_pkg.Observability()
+    o.counter("arena_test_total", policy="block").inc(5)
+    o.histogram("arena_test_seconds").record(0.25)
+    with o.span("work"):
+        pass
+    o.event("queue_depth", depth=3, producer="local")
+    o.event("drop", policy="drop-oldest", producer="local", batches=1,
+            matches=20)
+    path = dump_debug_bundle(o, tmp_path / "bundle",
+                             config={"mode": "test", "seed": 0})
+    assert path == tmp_path / "bundle"
+    manifest = json.loads((path / "MANIFEST.json").read_text())
+    assert set(manifest["files"]) == {
+        "trace.json", "metrics.json", "config.json", "events.json"
+    }
+    assert manifest["spans_recorded"] == 1
+    metrics = json.loads((path / "metrics.json").read_text())
+    assert metrics["counters"]['arena_test_total{policy="block"}'] == 5
+    assert metrics["histograms"]["arena_test_seconds"]["count"] == 1
+    trace = json.loads((path / "trace.json").read_text())
+    assert [e["name"] for e in trace["traceEvents"]] == ["work"]
+    config = json.loads((path / "config.json").read_text())
+    assert config == {"mode": "test", "seed": 0}
+    events = json.loads((path / "events.json").read_text())
+    assert len(events["events"]) == 2
+    assert events["queue_depth_timeline"] == [
+        [events["events"][0]["t"], 3]
+    ]
+
+
+def test_debug_bundle_write_is_atomic_and_replaces(tmp_path):
+    """No .tmp residue after a dump; a second dump REPLACES the bundle
+    whole (newer evidence, never a mix of two flights)."""
+    o = obs_pkg.Observability()
+    o.counter("a_total").inc()
+    target = tmp_path / "bundle"
+    dump_debug_bundle(o, target)
+    assert not (tmp_path / "bundle.tmp").exists()
+    o.counter("a_total").inc()
+    dump_debug_bundle(o, target)
+    assert not (tmp_path / "bundle.tmp").exists()
+    metrics = json.loads((target / "metrics.json").read_text())
+    assert metrics["counters"]["a_total"] == 2

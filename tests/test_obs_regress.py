@@ -1,0 +1,220 @@
+"""Perf-regression watchdog contracts (arena/obs/regress.py).
+
+The rc semantics over synthetic history lines (the ISSUE 8 acceptance
+criterion): rc 1 on an injected 20% throughput regression vs baseline,
+rc 0 within tolerance, rc 2 on anything unmeasurable (empty history,
+corrupt lines, a pinned metric with no run) — never conflated. The
+mutation audit carries a tolerance-comparison-inverted mutant
+(regressions pass, improvements fail);
+test_watchdog_flags_regressions_not_improvements is its named kill.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from arena.obs import regress
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write_history(path, *lines):
+    path.write_text(
+        "".join(json.dumps(line) + "\n" for line in lines)
+    )
+    return path
+
+
+def _line(value, metric="arena_ingest"):
+    return {"metric": metric, "value": value, "unit": "x_vs_cold_repack"}
+
+
+def _write_baseline(path, metrics):
+    path.write_text(json.dumps({"metrics": metrics}))
+    return path
+
+
+def _run(tmp_path, history_lines, metrics, tolerance=None):
+    h = _write_history(tmp_path / "hist.jsonl", *history_lines)
+    b = _write_baseline(tmp_path / "base.json", metrics)
+    argv = ["--history", str(h), "--baseline", str(b)]
+    if tolerance is not None:
+        argv += ["--tolerance", str(tolerance)]
+    return regress.main(argv)
+
+
+def _report(capsys):
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+# --- the acceptance criterion ----------------------------------------------
+
+
+def test_watchdog_flags_regressions_not_improvements(tmp_path, capsys):
+    """20% throughput drop vs the pin -> rc 1 naming the metric; a
+    within-tolerance delta -> rc 0; an IMPROVEMENT of any size -> rc 0
+    (the watchdog polices regressions, it never punishes a speedup).
+    The audit's inverted-comparison mutant dies on both halves."""
+    pin = {"arena_ingest": {"value": 15.0, "direction": "higher",
+                            "tolerance": 0.1}}
+    assert _run(tmp_path, [_line(12.0)], pin) == 1  # -20% beyond 10%
+    report = _report(capsys)
+    assert report["verdict"] == "regression"
+    assert report["regressions"] == ["arena_ingest"]
+    assert report["metrics"]["arena_ingest"]["regressed"] is True
+    assert _run(tmp_path, [_line(14.0)], pin) == 0  # -6.7% within 10%
+    assert _report(capsys)["verdict"] == "ok"
+    assert _run(tmp_path, [_line(40.0)], pin) == 0  # big improvement: ok
+    assert _report(capsys)["metrics"]["arena_ingest"]["regressed"] is False
+
+
+def test_lower_is_better_direction_inverts_the_band(tmp_path, capsys):
+    pin = {"arena_soak": {"value": 0.25, "direction": "lower",
+                          "tolerance": 0.2}}
+    hist = [_line(0.4, metric="arena_soak")]  # +60% latency: regression
+    assert _run(tmp_path, hist, pin) == 1
+    assert _report(capsys)["regressions"] == ["arena_soak"]
+    hist = [_line(0.28, metric="arena_soak")]  # +12% within 20%
+    assert _run(tmp_path, hist, pin) == 0
+    hist = [_line(0.1, metric="arena_soak")]  # improvement
+    assert _run(tmp_path, hist, pin) == 0
+
+
+def test_regression_exactly_at_tolerance_passes(tmp_path, capsys):
+    """The tolerance is the allowance, not the tripwire: a value
+    EXACTLY on the band edge passes; epsilon beyond fails. Pow2-exact
+    numbers so the boundary is float-exact."""
+    pin = {"arena_ingest": {"value": 16.0, "direction": "higher",
+                            "tolerance": 0.25}}
+    assert _run(tmp_path, [_line(12.0)], pin) == 0  # 16 * 0.75 exactly
+    assert _run(tmp_path, [_line(11.999)], pin) == 1
+    pin = {"arena_soak": {"value": 0.25, "direction": "lower",
+                          "tolerance": 1.0}}
+    assert _run(tmp_path, [_line(0.5, metric="arena_soak")], pin) == 0
+    assert _run(tmp_path, [_line(0.500001, metric="arena_soak")], pin) == 1
+    capsys.readouterr()
+
+
+def test_newest_run_wins_over_older_history(tmp_path, capsys):
+    pin = {"arena_ingest": {"value": 15.0, "direction": "higher",
+                            "tolerance": 0.1}}
+    # Old runs were bad; the NEWEST is fine -> ok (and vice versa).
+    assert _run(tmp_path, [_line(8.0), _line(15.2)], pin) == 0
+    assert _run(tmp_path, [_line(15.2), _line(8.0)], pin) == 1
+    report = _report(capsys)
+    assert report["metrics"]["arena_ingest"]["value"] == 8.0
+    assert report["metrics"]["arena_ingest"]["runs_seen"] == 2
+
+
+# --- noise-aware tolerances -------------------------------------------------
+
+
+def test_noise_aware_tolerance_derives_from_history_spread(tmp_path, capsys):
+    """Without an explicit pin tolerance, the band comes from the
+    metric's OWN prior wobble (3x relative stdev, floored): a noisy
+    metric tolerates a dip an explicitly-tight pin would flag."""
+    pin_noise = {"arena_ingest": {"value": 10.0, "direction": "higher"}}
+    noisy = [_line(v) for v in (10.0, 12.0, 8.0, 11.0, 8.0)]
+    assert _run(tmp_path, noisy, pin_noise) == 0
+    report = _report(capsys)
+    entry = report["metrics"]["arena_ingest"]
+    assert entry["tolerance_source"] == "history-noise"
+    assert entry["tolerance"] > 0.1  # wider than the floor
+    # The same final value under an explicit tight pin IS a regression.
+    pin_tight = {"arena_ingest": {"value": 10.0, "direction": "higher",
+                                  "tolerance": 0.05}}
+    assert _run(tmp_path, noisy, pin_tight) == 1
+    # Too few priors: the floor applies.
+    assert regress.noise_tolerance([10.0, 11.0], 0.1) == 0.1
+    assert regress.noise_tolerance([], 0.1) == 0.1
+
+
+# --- bad input is rc 2, never rc 1 ------------------------------------------
+
+
+def test_empty_history_is_bad_input(tmp_path, capsys):
+    pin = {"arena_ingest": {"value": 15.0, "direction": "higher"}}
+    assert _run(tmp_path, [], pin) == 2
+    report = _report(capsys)
+    assert report["verdict"] == "bad-input"
+    assert "empty" in report["error"]
+
+
+def test_pinned_metric_missing_from_history_is_bad_input(tmp_path, capsys):
+    pin = {"arena_serve": {"value": 100.0, "direction": "higher"}}
+    assert _run(tmp_path, [_line(15.0)], pin) == 2
+    assert "arena_serve" in _report(capsys)["error"]
+
+
+def test_corrupt_history_line_is_bad_input(tmp_path, capsys):
+    h = tmp_path / "hist.jsonl"
+    h.write_text(json.dumps(_line(15.0)) + "\nnot json {{{\n")
+    b = _write_baseline(
+        tmp_path / "base.json",
+        {"arena_ingest": {"value": 15.0, "direction": "higher"}},
+    )
+    assert regress.main(["--history", str(h), "--baseline", str(b)]) == 2
+    assert "line 2" in _report(capsys)["error"]
+
+
+def test_malformed_baseline_is_bad_input(tmp_path, capsys):
+    hist = [_line(15.0)]
+    bad_pins = [
+        {},  # empty metrics
+        {"arena_ingest": {"value": "fast", "direction": "higher"}},
+        {"arena_ingest": {"value": 15.0, "direction": "up"}},
+        {"arena_ingest": {"value": 15.0, "direction": "higher",
+                          "tolerance": -0.1}},
+    ]
+    for pins in bad_pins:
+        assert _run(tmp_path, hist, pins) == 2, pins
+    assert regress.main(
+        ["--history", str(tmp_path / "absent.jsonl"),
+         "--baseline", str(tmp_path / "base.json")]
+    ) == 2
+    capsys.readouterr()
+
+
+def test_unpinned_history_metrics_are_reported_not_failed(tmp_path, capsys):
+    pin = {"arena_ingest": {"value": 15.0, "direction": "higher",
+                            "tolerance": 0.1}}
+    hist = [_line(15.0), _line(99.0, metric="arena_new_mode")]
+    assert _run(tmp_path, hist, pin) == 0
+    assert _report(capsys)["unpinned"] == ["arena_new_mode"]
+
+
+def test_repo_baseline_file_is_valid():
+    """The committed BENCH_BASELINE.json (the standing bench gate's
+    pin) must always load: every metric numeric, every direction
+    legal."""
+    doc = regress.load_baseline(REPO / "BENCH_BASELINE.json")
+    assert set(doc["metrics"]) == {
+        "arena_elo_update_speedup", "arena_ingest", "arena_pipeline",
+        "arena_serve", "arena_soak",
+    }
+    assert doc["metrics"]["arena_soak"]["direction"] == "lower"
+
+
+@pytest.mark.slow
+def test_cli_subprocess_contract(tmp_path):
+    """The documented operator command end to end:
+    `python -m arena.obs.regress` with rc 0 on a healthy history and
+    rc 1 on a regressed one (one plain-python spawn, ~1.7s on this
+    image — slow-marked with the other subprocess-heavy acceptance
+    runs; the in-process tests above cover every branch)."""
+    h = _write_history(tmp_path / "hist.jsonl", _line(15.2))
+    b = _write_baseline(
+        tmp_path / "base.json",
+        {"arena_ingest": {"value": 15.0, "direction": "higher",
+                          "tolerance": 0.1}},
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "arena.obs.regress",
+         "--history", str(h), "--baseline", str(b)],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout.strip())["verdict"] == "ok"
