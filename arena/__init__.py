@@ -20,6 +20,14 @@ Modules:
   the whole engine (versioned on-disk format, `SnapshotError` reject
   posture), batched queries from immutable staleness-bounded views,
   production-mode sanitizer counters.
+- `arena.net`     — the network serving tier: the HTTP/JSON wire layer
+  (stdlib `ThreadingHTTPServer`; every response carries the staleness
+  watermark + the request's trace id), the multi-producer front door
+  (global sequence numbers at admission, merge strictly in sequence
+  order — async==sync bit-exact under N writers), and the
+  bounded-degradation load-shedding policy (shed batches coalesce
+  into a summary update; backlog beyond the staleness bound is
+  dropped COUNTED, never silently).
 - `arena.obs`     — zero-dependency observability: thread-safe metrics
   registry (counters/gauges/log2 histograms, Prometheus `render()`,
   one-JSON-line `dump()`, `NullRegistry` no-op twin) and span tracing
@@ -35,6 +43,7 @@ Modules:
 
 from arena.engine import ArenaEngine, bucket_size, pack_batch, pack_epoch
 from arena.ingest import MergeableCSR, StagingBuffers, chunk_layout
+from arena.net import ArenaHTTPServer, FrontDoor, FrontDoorError, WireClient
 from arena.obs import NullRegistry, Observability, Registry, Tracer
 from arena.pipeline import IngestPipeline, PipelineError
 from arena.ratings import (
@@ -53,8 +62,12 @@ from arena.serving import ArenaServer, ServingView, SnapshotError
 
 __all__ = [
     "ArenaEngine",
+    "ArenaHTTPServer",
     "ArenaServer",
+    "FrontDoor",
+    "FrontDoorError",
     "IngestPipeline",
+    "WireClient",
     "MergeableCSR",
     "NullRegistry",
     "Observability",

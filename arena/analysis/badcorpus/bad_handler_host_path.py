@@ -1,0 +1,19 @@
+"""jaxlint corpus: device jnp compute on a request-handler hot path.
+
+The wire tier's handlers (arena/net/server.py) answer from prebuilt
+host-side views: pure NumPy + stdlib, ~10k requests/s territory. A
+jnp op here pays a device dispatch and a transfer PER REQUEST for
+work np does in-place — the exact hazard on the serving path that
+`arena/net/` is pinned NOT to contain. Rule: jnp-on-host-path."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def handle_leaderboard(ratings, offset, limit):
+    """One /leaderboard request: sort the host ratings copy... on the
+    device, per request (the bug)."""
+    ratings = np.asarray(ratings, np.float32)
+    order = jnp.argsort(-ratings)
+    page = np.asarray(order)[offset : offset + limit]
+    return [(int(p), float(ratings[p])) for p in page]

@@ -105,8 +105,29 @@ repeat) and fails if live regresses more than ``ARENA_BENCH_OBS_TOL``
 (3%; a small absolute floor absorbs scheduler jitter at smoke sizes)
 — instrumented runs must also produce IDENTICAL groupings/ratings.
 
+A sixth mode, ``ARENA_BENCH_MODE=frontend``, measures the NETWORK
+serving tier (`arena/net/`): N simulated producers and M readers drive
+a real `ThreadingHTTPServer` over localhost HTTP — producers POST
+batches to /submit (each under its own producer label, admitted into
+the front door's global sequence order), readers page /leaderboard,
+/player/{id}, and /h2h. One ``arena_frontend`` JSON line reports
+queries/s (the headline ``value``) and ingest matches/s over the wire.
+THE HARD GATES (rc 2): the final ratings must be bit-exact to a sync
+single-producer replay of the front door's applied log in sequence
+order (the async==sync property under N writers); a thread-aware
+`RecompileSentinel` asserts zero steady-state compiles across every
+producer/reader/merge thread; every wire response must be well-formed
+(status 200/202, sorted pages, conserved rating mass, monotone
+watermarks). A separate FORCED-OVERLOAD phase (merge worker held, shed
+knobs tightened) then gates the shedding policy itself: the observed
+staleness must stay within the configured bound, every shed batch's
+trace must END with the explicit ``pipeline.dropped`` marker, and no
+dangling orphan spans may exist at quiescence (summary-batch compiles
+in this phase are legitimately outside the steady-state window — the
+coalesced shapes are new by construction).
+
 Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest | pipeline |
-serve | soak),
+serve | soak | frontend),
 ARENA_BENCH_MATCHES (100000), ARENA_BENCH_PLAYERS (1000),
 ARENA_BENCH_BATCH (8192), ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED
 (0), ARENA_BENCH_BT_ITERS (25), ARENA_BENCH_TOL (0.5 rating points —
@@ -118,7 +139,13 @@ pipeline mode — streamed batches per repeat), ARENA_BENCH_QUEUE_CAPACITY
 (8, pipeline/soak modes), ARENA_BENCH_BOOTSTRAP_ROUNDS (8, serve/soak
 modes), ARENA_BENCH_SOAK_BATCHES (16), ARENA_BENCH_SOAK_REFRESH_EVERY
 (4), ARENA_BENCH_SOAK_SNAPSHOT_EVERY (4), ARENA_BENCH_OBS_TOL (0.03),
-ARENA_BENCH_OBS_ABS_S (0.005),
+ARENA_BENCH_OBS_ABS_S (0.005), ARENA_BENCH_PRODUCERS (4, frontend
+mode), ARENA_BENCH_READERS (2), ARENA_BENCH_FRONTEND_BATCHES (6 per
+producer), ARENA_BENCH_OVERLOAD_BATCHES (8 per producer, the forced-
+overload phase), ARENA_BENCH_FRONTDOOR_CAPACITY (4, the overload
+phase's reorder-buffer bound in batches), ARENA_BENCH_SHED_STALENESS
+(2x ARENA_BENCH_DELTA, the overload phase's summary backlog bound in
+matches),
 ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
 sharded path when the backend is not yet initialized),
 ARENA_BENCH_HISTORY (unset — append every emitted JSON line to this
@@ -158,6 +185,7 @@ import numpy as np  # noqa: E402
 
 import bench  # noqa: E402  (exc_detail — the repo-wide error formatting)
 from arena import baseline, engine, ingest, ratings, serving, sharding  # noqa: E402
+from arena import net  # noqa: E402
 from arena import obs as obs_pkg  # noqa: E402
 from arena.analysis import sanitize  # noqa: E402
 from arena.obs import debug as obs_debug  # noqa: E402
@@ -269,6 +297,13 @@ def _gate_obs_overhead(null_s, live_s):
 
 class SoakGateError(AssertionError):
     """A soak-bench hard gate failed (recompiles in the steady state)."""
+
+
+class FrontendGateError(AssertionError):
+    """A frontend-bench hard gate failed: the shedding policy broke its
+    staleness bound, a shed trace did not end with its dropped marker,
+    dangling orphan spans survived quiescence, or the forced overload
+    failed to shed at all (an un-exercised gate is no gate)."""
 
 
 def _env_int(name, default):
@@ -1201,6 +1236,302 @@ def run_soak_benchmark():
     }
 
 
+def run_frontend_benchmark():
+    """The network-tier measurement: N producers + M readers over REAL
+    localhost HTTP against `arena/net/`'s wire server and front door.
+
+    Phase 1 (the steady state, sentinel-gated): producers POST fixed-
+    size batches to /submit while readers page the query endpoints;
+    the headline ``value`` is wire queries/s under that concurrent
+    ingest. Phase 2 (forced overload): the merge worker is held and
+    the shed knobs tightened, so continued submissions MUST shed —
+    gating that the coalesce policy holds its staleness bound, ends
+    every shed trace with the ``pipeline.dropped`` marker, and leaves
+    zero dangling orphans at quiescence. The equivalence HARD gate
+    then replays the front door's full applied log (both phases,
+    summary updates included) through a sync single-producer engine in
+    sequence order and requires bit-exact ratings."""
+    base_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
+    stream_batch = _env_int("ARENA_BENCH_DELTA", 10_000)
+    num_players = _env_int("ARENA_BENCH_PLAYERS", 1_000)
+    batch = _env_int("ARENA_BENCH_BATCH", 8_192)
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+    producers = _env_int("ARENA_BENCH_PRODUCERS", 4)
+    readers = _env_int("ARENA_BENCH_READERS", 2)
+    frontend_batches = _env_int("ARENA_BENCH_FRONTEND_BATCHES", 6)
+    overload_batches = _env_int("ARENA_BENCH_OVERLOAD_BATCHES", 8)
+    overload_capacity = _env_int("ARENA_BENCH_FRONTDOOR_CAPACITY", 4)
+    shed_staleness = _env_int("ARENA_BENCH_SHED_STALENESS", 2 * stream_batch)
+    queue_capacity = _env_int("ARENA_BENCH_QUEUE_CAPACITY", 8)
+    tol = float(os.environ.get("ARENA_BENCH_TOL", EQUIVALENCE_TOL))
+
+    total = base_matches + stream_batch * (
+        1 + producers * (frontend_batches + overload_batches)
+    )
+    winners, losers = make_matches(total, num_players, seed)
+
+    obs_live = obs_pkg.Observability(trace_capacity=16384)
+    _register_active_obs(obs_live)
+    srv = serving.ArenaServer(
+        num_players=num_players,
+        max_staleness_matches=stream_batch,
+        obs=obs_live,
+    )
+    eng = srv.engine
+    base_slices = _batch_slices(base_matches, batch)
+    for start, stop in base_slices:
+        eng.ingest(winners[start:stop], losers[start:stop])
+    eng.start_pipeline(capacity=queue_capacity)
+    # Phase 1 must not shed (a shed's coalesced summary is a NEW batch
+    # shape, i.e. a legitimate compile — the steady-state window keeps
+    # those out by giving the buffer room for the whole burst).
+    frontdoor = net.FrontDoor(
+        eng,
+        capacity=producers * frontend_batches + 2,
+        max_staleness_matches=total,
+        record_applied=True,
+    )
+    wire = net.ArenaHTTPServer(srv, frontdoor=frontdoor).start()
+
+    # Warmup over the wire: the stream bucket's compile + first view.
+    warm = net.WireClient(wire.host, wire.port)
+    w0 = winners[base_matches : base_matches + stream_batch]
+    l0 = losers[base_matches : base_matches + stream_batch]
+    status, _resp = warm.submit(w0, l0, producer="warmup")
+    assert status == net.server.STATUS_ACCEPTED
+    frontdoor.flush()
+    warm.get("/leaderboard?offset=0&limit=10")
+    warm.close()
+
+    sentinel = sanitize.RecompileSentinel(update=eng.num_compiles)
+    base_mass = num_players * float(ratings.DEFAULT_BASE)
+    stop_event = threading.Event()
+    torn = []
+    counts = {"queries": 0}
+    counts_lock = threading.Lock()
+    max_mass_dev = [0.0]
+
+    def reader(rid):
+        client = net.WireClient(wire.host, wire.port)
+        last_watermark = 0
+        pid = (rid * 7) % num_players
+        mine = 0
+        try:
+            while not stop_event.is_set():
+                for path in (
+                    "/leaderboard?offset=0&limit=10",
+                    f"/player/{pid}",
+                    f"/h2h?a={pid}&b={(pid + 1) % num_players}",
+                ):
+                    status, resp = client.get(path)
+                    if status != 200:
+                        torn.append(f"reader {rid}: {path} -> {status}")
+                        return
+                    mine += 1
+                    if resp["watermark"] < last_watermark:
+                        torn.append(f"reader {rid}: watermark went backwards")
+                        return
+                    last_watermark = resp["watermark"]
+                    if "leaderboard" in resp:
+                        page = [row["rating"] for row in resp["leaderboard"]]
+                        if page != sorted(page, reverse=True):
+                            torn.append(f"reader {rid}: unsorted page")
+                            return
+                        dev = abs(resp["view_ratings_sum"] - base_mass) / num_players
+                        max_mass_dev[0] = max(max_mass_dev[0], dev)
+        finally:
+            with counts_lock:
+                counts["queries"] += mine
+            client.close()
+
+    def producer(pid, slices):
+        client = net.WireClient(wire.host, wire.port)
+        try:
+            for start, stop in slices:
+                status, resp = client.submit(
+                    winners[start:stop], losers[start:stop],
+                    producer=f"producer-{pid}",
+                )
+                if status != net.server.STATUS_ACCEPTED:
+                    torn.append(f"producer {pid}: submit -> {status} {resp}")
+                    return
+        finally:
+            client.close()
+
+    # --- phase 1: the measured steady state --------------------------
+    offset = base_matches + stream_batch
+    producer_slices = []
+    for p in range(producers):
+        slices = []
+        for i in range(frontend_batches):
+            start = offset + (p * frontend_batches + i) * stream_batch
+            slices.append((start, start + stream_batch))
+        producer_slices.append(slices)
+    offset += producers * frontend_batches * stream_batch
+
+    reader_threads = [
+        threading.Thread(target=reader, args=(r,), daemon=True)
+        for r in range(readers)
+    ]
+    producer_threads = [
+        threading.Thread(target=producer, args=(p, producer_slices[p]), daemon=True)
+        for p in range(producers)
+    ]
+    t0 = time.perf_counter()
+    for t in reader_threads:
+        t.start()
+    for t in producer_threads:
+        t.start()
+    for t in producer_threads:
+        t.join(timeout=600.0)
+    frontdoor.flush()
+    ingest_s = time.perf_counter() - t0
+    stop_event.set()
+    for t in reader_threads:
+        t.join(timeout=60.0)
+    elapsed = time.perf_counter() - t0
+    # Zero new compiles across every wire/producer/reader/merge thread
+    # in the measured window (the steady-state contract over HTTP).
+    sentinel.assert_no_new_compiles()
+    if torn:
+        raise EquivalenceError(float("inf"), tol)
+    if not max_mass_dev[0] < tol:
+        raise EquivalenceError(max_mass_dev[0], tol)
+    phase1_shed = frontdoor.shed_batches
+    qps = counts["queries"] / elapsed
+    streamed = producers * frontend_batches * stream_batch
+
+    # --- phase 2: forced overload, the shedding-policy gates ----------
+    frontdoor.reset_staleness_peak()
+    frontdoor.set_policy(
+        capacity=overload_capacity, max_staleness_matches=shed_staleness
+    )
+    frontdoor.pause()
+    overload_slices = []
+    for p in range(producers):
+        slices = []
+        for i in range(overload_batches):
+            start = offset + (p * overload_batches + i) * stream_batch
+            slices.append((start, start + stream_batch))
+        overload_slices.append(slices)
+    overload_threads = [
+        threading.Thread(target=producer, args=(p, overload_slices[p]), daemon=True)
+        for p in range(producers)
+    ]
+    for t in overload_threads:
+        t.start()
+    for t in overload_threads:
+        t.join(timeout=600.0)
+    staleness_peak = frontdoor.max_staleness_seen
+    staleness_bound = frontdoor.staleness_bound(stream_batch, producers=producers)
+    frontdoor.resume()
+    frontdoor.flush()
+    if torn:
+        raise EquivalenceError(float("inf"), tol)
+    shed_total = frontdoor.shed_batches
+    overload_shed = shed_total - phase1_shed
+    if overload_shed <= 0:
+        raise FrontendGateError(
+            "the forced-overload phase shed nothing: the shedding policy "
+            "was never exercised, so its gates measured nothing"
+        )
+    if staleness_peak > staleness_bound:
+        raise FrontendGateError(
+            f"observed staleness {staleness_peak} matches exceeds the "
+            f"configured bound {staleness_bound}; the coalesce policy's "
+            "bounded-degradation contract broke"
+        )
+    dropped_markers = sum(
+        1 for rec in obs_live.tracer.spans() if rec.name == "pipeline.dropped"
+    )
+    if dropped_markers < shed_total:
+        raise FrontendGateError(
+            f"{shed_total} batches were shed but only {dropped_markers} "
+            "traces end with the pipeline.dropped marker; a shed request's "
+            "trace must END, never dangle"
+        )
+    dangling = sum(
+        1 for _rec, reason in obs_live.tracer.orphans() if reason == "dangling"
+    )
+    if dangling:
+        raise FrontendGateError(
+            f"{dangling} dangling orphan span(s) at quiescence; every wire "
+            "request's trace must chain to an allocated root"
+        )
+
+    # --- the equivalence HARD gate: sync replay of the applied log ---
+    # (both phases, summary updates included) in sequence order.
+    eng_sync = engine.ArenaEngine(num_players)
+    for start, stop in base_slices:
+        eng_sync.ingest(winners[start:stop], losers[start:stop])
+    # The warmup batch rode the front door, so the applied log already
+    # carries it — the log alone IS the post-base stream.
+    for _kind, w, l in frontdoor.applied_log:
+        eng_sync.ingest(w, l)
+    max_diff = float(
+        np.abs(np.asarray(eng.ratings) - np.asarray(eng_sync.ratings)).max()
+    )
+    if not max_diff < tol:
+        raise EquivalenceError(max_diff, tol)
+
+    stats = srv.stats()
+    lat = obs_live.histogram(
+        "arena_http_request_latency_seconds", endpoint="leaderboard"
+    )
+    p50 = lat.percentile(0.5)
+    p99 = lat.percentile(0.99)
+    wire.close()
+    frontdoor.close()
+    srv.close()
+    return {
+        "metric": "arena_frontend",
+        "value": round(qps, 2),
+        "unit": "wire_queries_per_s",
+        "vs_baseline": None,
+        "params": {
+            "base_matches": base_matches,
+            "stream_batch": stream_batch,
+            "producers": producers,
+            "readers": readers,
+            "frontend_batches": frontend_batches,
+            "overload_batches": overload_batches,
+            "overload_capacity": overload_capacity,
+            "shed_staleness_matches": shed_staleness,
+            "num_players": num_players,
+            "batch_size": batch,
+            "seed": seed,
+            "queue_capacity": queue_capacity,
+            "host_cores": os.cpu_count() or 1,
+        },
+        "frontend": {
+            "elapsed_s": round(elapsed, 6),
+            "wire_queries": counts["queries"],
+            "wire_queries_per_s": round(qps, 2),
+            "request_latency_ms": {
+                "p50": round(p50 * 1e3, 3) if p50 is not None else None,
+                "p99": round(p99 * 1e3, 3) if p99 is not None else None,
+            },
+            "ingest_stream_s": round(ingest_s, 6),
+            "ingest_matches_per_s": round(streamed / ingest_s),
+            "requests_by_endpoint": stats["net"]["requests_by_endpoint"],
+            "requests_by_status": stats["net"]["requests_by_status"],
+            "shed_batches": shed_total,
+            "shed_matches_coalesced": frontdoor.shed_matches,
+            "dropped_matches_staleness": frontdoor.dropped_matches,
+            "shed_by_policy": stats["net"]["shed_batches_by_policy"],
+            "summaries_applied": frontdoor.summaries_applied,
+            "max_staleness_matches_seen": staleness_peak,
+            "staleness_bound": staleness_bound,
+            "dropped_marker_spans": dropped_markers,
+            "trace_dangling_orphans": 0,  # gate raised otherwise
+            "steady_state_new_compiles": 0,  # sentinel raised otherwise
+            "max_view_mass_dev": round(max_mass_dev[0], 6),
+        },
+        "equivalence_ok": True,
+        "max_rating_diff": round(max_diff, 6),
+    }
+
+
 def main() -> int:
     rc = 0
     mode = os.environ.get("ARENA_BENCH_MODE", "elo")
@@ -1209,6 +1540,7 @@ def main() -> int:
         "pipeline": (run_pipeline_benchmark, "x_vs_sync_ingest"),
         "serve": (run_serve_benchmark, "queries_per_s"),
         "soak": (run_soak_benchmark, "p99_query_latency_ms"),
+        "frontend": (run_frontend_benchmark, "wire_queries_per_s"),
     }
     runner, unit = runners.get(mode, (run_benchmark, "x_vs_naive_baseline"))
     try:
@@ -1256,6 +1588,20 @@ def main() -> int:
         line = json.dumps(
             {
                 "metric": "arena_bench_soak_gate_failure",
+                "value": -1,
+                "unit": unit,
+                "vs_baseline": None,
+                "error": str(exc),
+                "debug_bundle": _gate_debug_bundle(mode),
+            }
+        )
+        rc = EXIT_EQUIVALENCE_FAILURE
+    except FrontendGateError as exc:
+        # The wire tier's shedding contract broke (staleness bound,
+        # dropped markers, orphans): a measured verdict, never a crash.
+        line = json.dumps(
+            {
+                "metric": "arena_bench_frontend_gate_failure",
                 "value": -1,
                 "unit": unit,
                 "vs_baseline": None,

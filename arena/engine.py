@@ -414,7 +414,7 @@ class ArenaEngine:
         self._pipeline = pipeline_mod.IngestPipeline(self, **kwargs)
         return self._pipeline
 
-    def ingest_async(self, winners, losers):
+    def ingest_async(self, winners, losers, producer=None):
         """`ingest` through the overlapped pipeline: the batch is
         validated HERE (a malformed batch raises at the call site, no
         state change) and handed to the background packer thread;
@@ -422,8 +422,11 @@ class ArenaEngine:
         `flush()` calls on the calling thread. Rating semantics are
         bit-exact `ingest()` — same slots, same jitted update, same
         order — the async-ness only moves the host packing off the
-        caller's critical path. Returns the number of batches still
-        pending (0 means everything submitted so far has applied)."""
+        caller's critical path. `producer` labels THIS batch's submit
+        metrics (the multi-producer front door passes each batch's
+        original producer through). Returns the number of batches
+        still pending (0 means everything submitted so far has
+        applied)."""
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
         _validate_matches(self.num_players, w, l)
@@ -434,7 +437,7 @@ class ArenaEngine:
         # the packer's pack/merge spans and the eventual dispatch spans
         # — on whatever threads they run — parent back to THIS root.
         with self.obs.span("batch.submit"):
-            self._pipeline.submit(w, l)
+            self._pipeline.submit(w, l, producer=producer)
         return self._pipeline.pending()
 
     def flush(self):

@@ -1,0 +1,59 @@
+"""arena.net — the network serving tier (ROADMAP item 1).
+
+Three parts, layered over the existing serving and pipeline stack:
+
+- `arena.net.protocol`  — the wire protocol: route parsing, the
+  response envelope (staleness watermark + request trace id in every
+  JSON response), submit-body validation, and `WireClient`, the
+  stdlib persistent-connection consumer half.
+- `arena.net.frontdoor` — the multi-producer front door: global
+  sequence numbers assigned at admission, a reorder-buffer merge that
+  applies strictly in sequence order (async==sync bit-exact under N
+  writers), and bounded-degradation load shedding (oldest batches
+  coalesce into a summary update; the summary's backlog is staleness-
+  bounded, trimming beyond it is counted, never silent).
+- `arena.net.server`    — the HTTP/JSON server (`ThreadingHTTPServer`,
+  stdlib only): /leaderboard, /player/{id}, /h2h, /submit, /stats
+  (Prometheus render()), /healthz.
+
+What this tier deliberately defers (ROADMAP item 2): replica catch-up
+— a read-only `ArenaHTTPServer(frontdoor=None)` already serves 503 on
+/submit, but keeping it fresh needs incremental snapshots + log
+shipping, not a wire-layer feature.
+"""
+
+from arena.net.frontdoor import (
+    DEFAULT_CAPACITY,
+    DEFAULT_MAX_STALENESS_MATCHES,
+    POLICY_COALESCE,
+    POLICY_STALENESS,
+    SUMMARY_PRODUCER,
+    FrontDoor,
+    FrontDoorError,
+)
+from arena.net.protocol import (
+    ENDPOINTS,
+    ProtocolError,
+    WireClient,
+    make_response,
+    parse_path,
+    parse_submit_body,
+)
+from arena.net.server import ArenaHTTPServer
+
+__all__ = [
+    "ArenaHTTPServer",
+    "DEFAULT_CAPACITY",
+    "DEFAULT_MAX_STALENESS_MATCHES",
+    "ENDPOINTS",
+    "FrontDoor",
+    "FrontDoorError",
+    "POLICY_COALESCE",
+    "POLICY_STALENESS",
+    "ProtocolError",
+    "SUMMARY_PRODUCER",
+    "WireClient",
+    "make_response",
+    "parse_path",
+    "parse_submit_body",
+]

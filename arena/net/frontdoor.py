@@ -1,0 +1,535 @@
+"""Multi-producer front door: admission, total order, bounded shedding.
+
+`IngestPipeline` (PR 4) assumes ONE producer: its FIFO queue order IS
+the submission order, which is what makes async ingest bit-exact to
+sync. Real arena traffic has many concurrent frontends on the submit
+path (ROADMAP item 1), and "whatever order the threads happened to
+interleave" is not a replayable order. This module generalizes the
+submit path without giving that property up:
+
+1. **Admission = the total order.** Every batch is assigned a GLOBAL
+   SEQUENCE NUMBER at admission (`admit()`, one counter under the
+   front-door lock). Admission and delivery are deliberately two
+   phases — `admit()` hands out the ticket, `deliver()` lands the
+   batch in the reorder buffer — because that is the real shape of a
+   wire front door (the ticket is issued when the request is accepted;
+   the body lands when the producer's thread gets back around to it),
+   and the gap between them is exactly where N producers interleave.
+   `submit()` is the one-call form HTTP handlers use.
+
+2. **Deterministic merge.** A single merge worker applies batches in
+   SEQUENCE order, never arrival order: it waits until the next
+   expected sequence number has been delivered before applying
+   anything later (a reorder buffer, not a race). The applied stream
+   is therefore a single well-defined total order no matter how many
+   producers submitted concurrently — and replaying that order through
+   synchronous single-producer `ingest()` lands on BIT-EXACT the same
+   ratings (the async==sync equivalence property, now under N
+   writers; `applied_log` records the order so tests and the frontend
+   bench can replay it). Batches reach the engine through
+   `ingest_async`, so the PR 4 packer overlap still applies downstream.
+
+3. **Bounded-degradation shedding** (policy ``"coalesce"``). The old
+   backpressure choice was all-or-nothing: block the producer, or
+   drop the oldest batch on the floor. Here, when the reorder buffer
+   exceeds `capacity` batches, the OLDEST contiguous batches are shed
+   as batches — their traces END with the existing `pipeline.dropped`
+   marker, their producers' policy-labeled drop counters tick — but
+   their MATCHES are coalesced into a pending SUMMARY UPDATE that is
+   applied as one batch at the shed batches' position in the total
+   order. Overload costs per-batch rating granularity and freshness
+   (k updates become 1, applied late), never silent data loss. The
+   summary itself is staleness-bounded: once it would carry more than
+   `max_staleness_matches` of backlog, its oldest whole segments are
+   dropped FOR REAL and counted (`policy="staleness"` on the existing
+   dropped-matches counter) — so the applied watermark can never lag
+   the admitted stream by more than a computable bound, and the drop
+   is a counted verdict, not an accident.
+
+Crash-restart: `close(spill=True)` extracts the not-yet-applied state
+— the summary segments plus the per-producer queued batches in
+sequence order — exactly what a durable snapshot persists next to the
+engine spill; `resubmit_spilled()` re-admits it in the same
+deterministic order on a restarted front door. Spilled summary
+segments are re-admitted as INDIVIDUAL batches (the restart undoes
+pending coalescing: full granularity is restored, and the replay is
+bit-exact to an uninterrupted run that never shed them).
+
+Metrics ride the PR 7 schema unchanged: submit-path counters keep
+their `producer` label (the per-producer streams are keyed by it; the
+inner pipeline counts each batch under its ORIGINAL producer, not the
+front door's), drops report through the existing policy-labeled
+counters, and the per-producer queue-depth gauge tracks this buffer.
+Everything here is host-side NumPy + stdlib threading — no jax (the
+jitted work stays behind `ArenaEngine`).
+"""
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from arena import engine as engine_mod
+from arena.obs import context as trace_context
+
+POLICY_COALESCE = "coalesce"
+POLICY_STALENESS = "staleness"
+
+# Reorder-buffer capacity in BATCHES before coalescing sheds the
+# oldest; small like the pipeline queue — it bounds freshness, not RAM.
+DEFAULT_CAPACITY = 16
+
+# Backlog the coalesced summary may carry before its oldest segments
+# are dropped for real (matches, not batches).
+DEFAULT_MAX_STALENESS_MATCHES = 100_000
+
+# Producer label the coalesced summary update is submitted under.
+SUMMARY_PRODUCER = "coalesced"
+
+# Wait quantum: every blocking loop re-checks worker liveness.
+_WAIT_S = 0.05
+
+
+class FrontDoorError(RuntimeError):
+    """The front door cannot make progress (worker dead or errored)."""
+
+
+class _Ticket:
+    """One admitted batch: the sequence slot plus its payload."""
+
+    __slots__ = ("seq", "producer", "winners", "losers", "ctx")
+
+    def __init__(self, seq, producer, winners, losers, ctx):
+        self.seq = seq
+        self.producer = producer
+        self.winners = winners
+        self.losers = losers
+        self.ctx = ctx
+
+
+class FrontDoor:
+    """Multi-producer submit surface over one `ArenaEngine`.
+
+    The front door owns the engine's WRITE path while it is open:
+    batches reach the engine only through the merge worker, in
+    sequence order. Queries/snapshots stay wherever they were
+    (`ArenaServer` reads immutable views; it never contends here).
+    """
+
+    def __init__(self, engine, capacity=DEFAULT_CAPACITY,
+                 max_staleness_matches=DEFAULT_MAX_STALENESS_MATCHES,
+                 record_applied=False, pipeline_producer="frontdoor"):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1 batch, got {capacity}")
+        if max_staleness_matches < 0:
+            raise ValueError(
+                f"max_staleness_matches must be >= 0, got {max_staleness_matches}"
+            )
+        self._eng = engine
+        self.capacity = capacity
+        self.max_staleness_matches = max_staleness_matches
+        self.policy = POLICY_COALESCE
+        self._cv = threading.Condition()
+        self._next_seq = 0  # next sequence number to assign (admission)
+        self._next_apply = 0  # next sequence number the merge may apply
+        self._buffer = {}  # seq -> _Ticket, delivered but not applied
+        self._summary = deque()  # (producer, winners, losers) shed segments
+        self._summary_matches = 0
+        self._applying = False  # worker holds a popped item right now
+        self._closed = False
+        self._held = False  # pause() — the forced-overload hook
+        self._error = None
+        self.admitted_batches = 0
+        self.admitted_matches = 0
+        self.delivered_batches = 0
+        self.applied_batches = 0
+        self.applied_matches = 0
+        self.shed_batches = 0  # coalesced into the summary (matches kept)
+        self.shed_matches = 0
+        self.dropped_matches = 0  # trimmed from the summary (really lost)
+        self.summaries_applied = 0
+        self.max_staleness_seen = 0
+        self._producer_pending = {}  # producer -> batches in the buffer
+        # Matches the engine had applied before this front door opened:
+        # staleness_matches() measures OUR backlog, not history's.
+        self._base_applied = engine.matches_applied
+        # The deterministic application order, recorded for replay
+        # (tests and the frontend bench's HARD equivalence gate).
+        self.record_applied = record_applied
+        self.applied_log = []
+        if engine._pipeline is None:
+            engine.start_pipeline(producer=pipeline_producer)
+        self._thread = threading.Thread(
+            target=self._merge_loop, name="arena-frontdoor-merge", daemon=True
+        )
+        self._thread.start()
+
+    # --- accounting ---------------------------------------------------
+
+    def _obs(self):
+        return self._eng.obs
+
+    def staleness_matches(self):
+        """Matches admitted but not yet applied (nor dropped): the
+        front door's freshness lag over the engine's watermark."""
+        with self._cv:
+            return self._staleness_locked()
+
+    def _staleness_locked(self):
+        return (
+            self.admitted_matches
+            - self.dropped_matches
+            - (self._eng.matches_applied - self._base_applied)
+        )
+
+    def staleness_bound(self, max_batch, producers=1):
+        """The computable worst-case staleness under policy
+        ``coalesce`` for batches up to `max_batch` matches: the summary
+        cap, plus a full reorder buffer, plus the inner pipeline
+        queue, plus one batch in flight per stage and one undelivered
+        ticket per producer. The frontend bench gates the OBSERVED
+        staleness against this bound."""
+        pipe = self._eng._pipeline
+        pipe_capacity = pipe.capacity if pipe is not None else 0
+        return self.max_staleness_matches + max_batch * (
+            self.capacity + pipe_capacity + producers + 2
+        )
+
+    def pending_batches(self):
+        with self._cv:
+            return len(self._buffer) + (1 if self._summary else 0)
+
+    def _raise_if_failed_locked(self):
+        if self._error is not None:
+            raise FrontDoorError(
+                f"front door failed in the merge worker: {self._error!r}"
+            ) from self._error
+
+    def _check_worker_locked(self):
+        self._raise_if_failed_locked()
+        if (
+            (self._buffer or self._summary or self._applying)
+            and not self._held
+            and not self._thread.is_alive()
+        ):
+            raise FrontDoorError(
+                "merge worker is not running but batches are queued; "
+                "the front door cannot drain"
+            )
+
+    def _end_dropped_trace(self, ctx):
+        """The existing terminal marker: a shed batch's trace ENDS with
+        `pipeline.dropped`, same as the PR 7 pipeline drop path."""
+        self._obs().tracer.record_span(
+            "pipeline.dropped", time.perf_counter(), 0.0, context=ctx
+        )
+
+    # --- admission (any producer thread) ------------------------------
+
+    def admit(self, winners, losers, producer="local"):
+        """Phase 1: validate the batch and assign its global sequence
+        number — the batch's slot in the total order. Raises at the
+        call site on malformed input with no state change."""
+        if not producer or not isinstance(producer, str):
+            raise ValueError(
+                f"producer label must be a non-empty str, got {producer!r}"
+            )
+        w = np.asarray(winners, np.int32)
+        l = np.asarray(losers, np.int32)
+        engine_mod._validate_matches(self._eng.num_players, w, l)
+        ctx = trace_context.current()  # the request's root (or None)
+        with self._cv:
+            if self._closed:
+                raise FrontDoorError("front door is closed; open a new one")
+            self._raise_if_failed_locked()
+            seq = self._next_seq
+            self._next_seq += 1
+            self.admitted_batches += 1
+            self.admitted_matches += int(w.shape[0])
+        return _Ticket(seq, producer, w, l, ctx)
+
+    def deliver(self, ticket):
+        """Phase 2: land an admitted batch in the reorder buffer. The
+        merge worker applies it once every earlier sequence number has
+        been delivered (or shed) — never before."""
+        obs = self._obs()
+        with self._cv:
+            if self._closed:
+                raise FrontDoorError("front door is closed; open a new one")
+            self._raise_if_failed_locked()
+            self._buffer[ticket.seq] = ticket
+            self.delivered_batches += 1
+            pend = self._producer_pending
+            pend[ticket.producer] = pend.get(ticket.producer, 0) + 1
+            depth = pend[ticket.producer]
+            stale = self._staleness_locked()
+            self.max_staleness_seen = max(self.max_staleness_seen, stale)
+            self._shed_locked()
+            self._cv.notify_all()
+        obs.gauge(
+            "arena_pipeline_queue_depth", producer=ticket.producer
+        ).set(float(depth))
+        obs.gauge("arena_frontdoor_staleness_matches").set(float(stale))
+        obs.event("queue_depth", depth=depth, producer=ticket.producer)
+        return ticket.seq
+
+    def submit(self, winners, losers, producer="local"):
+        """admit + deliver in one call (the HTTP handler's form).
+        Returns the batch's sequence number."""
+        return self.deliver(self.admit(winners, losers, producer))
+
+    # --- the shedding policy (runs under the lock) --------------------
+
+    def _shed_locked(self):
+        """Bounded-degradation shedding. Over `capacity` buffered
+        batches: coalesce the oldest contiguous batches into the
+        summary (batch identity dropped — counted, trace ended — but
+        matches preserved). Over `max_staleness_matches` of summary
+        backlog: drop the oldest whole segments for real (counted
+        under policy="staleness")."""
+        obs = self._obs()
+        while len(self._buffer) > self.capacity:
+            item = self._buffer.pop(self._next_apply, None)
+            if item is None:
+                break  # head not delivered yet: nothing contiguous to shed
+            self._next_apply = item.seq + 1
+            n = int(item.winners.shape[0])
+            self._summary.append((item.producer, item.winners, item.losers))
+            self._summary_matches += n
+            self.shed_batches += 1
+            self.shed_matches += n
+            pend = self._producer_pending
+            pend[item.producer] = pend.get(item.producer, 1) - 1
+            obs.counter(
+                "arena_pipeline_dropped_batches_total",
+                policy=POLICY_COALESCE, producer=item.producer,
+            ).inc()
+            obs.event("shed", policy=POLICY_COALESCE, producer=item.producer,
+                      batches=1, matches=n)
+            self._end_dropped_trace(item.ctx)
+        while self._summary_matches > self.max_staleness_matches:
+            producer, w, _l = self._summary.popleft()
+            n = int(w.shape[0])
+            self._summary_matches -= n
+            self.dropped_matches += n
+            obs.counter(
+                "arena_pipeline_dropped_matches_total",
+                policy=POLICY_STALENESS, producer=producer,
+            ).inc(n)
+            obs.event("drop", policy=POLICY_STALENESS, producer=producer,
+                      batches=1, matches=n)
+
+    # --- the merge worker ---------------------------------------------
+
+    def _pop_next_locked(self):
+        """The deterministic merge: the pending summary (always older
+        than anything still buffered) first, then the buffered batch
+        at the next expected SEQUENCE number — never whichever batch
+        happened to arrive first."""
+        if self._summary:
+            segments = list(self._summary)
+            self._summary.clear()
+            self._summary_matches = 0
+            return ("summary", segments)
+        item = self._buffer.pop(self._next_apply, None)
+        if item is None:
+            return None
+        self._next_apply = item.seq + 1
+        pend = self._producer_pending
+        pend[item.producer] = pend.get(item.producer, 1) - 1
+        return ("batch", item)
+
+    def _merge_loop(self):
+        while True:
+            with self._cv:
+                popped = None
+                while True:
+                    if not self._held:
+                        popped = self._pop_next_locked()
+                        if popped is not None:
+                            break
+                    if self._closed:
+                        return  # closed and (contiguously) drained
+                    self._cv.wait()
+                self._applying = True
+            try:
+                self._apply(popped)
+            except BaseException as exc:  # noqa: BLE001 — surface on callers
+                with self._cv:
+                    self._error = exc
+                    self._applying = False
+                    for item in self._buffer.values():
+                        self._end_dropped_trace(item.ctx)
+                    self._buffer.clear()
+                    self._summary.clear()
+                    self._summary_matches = 0
+                    self._cv.notify_all()
+                return
+            with self._cv:
+                self._applying = False
+                self._cv.notify_all()
+
+    def _apply(self, popped):
+        kind, payload = popped
+        obs = self._obs()
+        if kind == "summary":
+            w = np.concatenate([s[1] for s in payload])
+            l = np.concatenate([s[2] for s in payload])
+            # The summary update: one batch, one rating step, applied
+            # at the shed batches' position in the total order.
+            with obs.span("frontdoor.summary_apply"):
+                self._eng.ingest_async(w, l, producer=SUMMARY_PRODUCER)
+            with self._cv:
+                self.summaries_applied += 1
+                self.applied_matches += int(w.shape[0])
+            if self.record_applied:
+                self.applied_log.append(("summary", w, l))
+        else:
+            item = payload
+            # Adopt the request's context: the apply span (and the
+            # batch.submit/pack/dispatch spans under it) parent into
+            # the submitting request's trace across threads.
+            with trace_context.attach(item.ctx), obs.span("frontdoor.apply"):
+                self._eng.ingest_async(
+                    item.winners, item.losers, producer=item.producer
+                )
+            with self._cv:
+                self.applied_batches += 1
+                self.applied_matches += int(item.winners.shape[0])
+            if self.record_applied:
+                self.applied_log.append(("batch", item.winners, item.losers))
+
+    # --- overload / drain / shutdown ----------------------------------
+
+    def set_policy(self, capacity=None, max_staleness_matches=None):
+        """Retune the shedding knobs on a LIVE front door — the
+        operational lever (tighten under incident, loosen after; the
+        frontend bench's forced-overload phase uses it). Applies
+        immediately: the shed check runs once here and at every
+        subsequent delivery."""
+        with self._cv:
+            if capacity is not None:
+                if capacity < 1:
+                    raise ValueError(
+                        f"capacity must be >= 1 batch, got {capacity}"
+                    )
+                self.capacity = capacity
+            if max_staleness_matches is not None:
+                if max_staleness_matches < 0:
+                    raise ValueError(
+                        f"max_staleness_matches must be >= 0, got "
+                        f"{max_staleness_matches}"
+                    )
+                self.max_staleness_matches = max_staleness_matches
+            self._shed_locked()
+            self._cv.notify_all()
+
+    def reset_staleness_peak(self):
+        """Restart the `max_staleness_seen` high-water mark (phase
+        boundaries in the bench: gate each phase against its own
+        configured bound)."""
+        with self._cv:
+            self.max_staleness_seen = self._staleness_locked()
+
+    def pause(self):
+        """Hold the merge worker (admissions continue): the forced-
+        overload hook the shedding tests and the frontend bench use to
+        model a stalled apply path deterministically."""
+        with self._cv:
+            self._held = True
+
+    def resume(self):
+        with self._cv:
+            self._held = False
+            self._cv.notify_all()
+
+    def flush(self):
+        """Block until every admitted batch has been delivered, merged
+        in sequence order, and applied through the engine (inner
+        pipeline drained too). Callers must have completed their
+        admit/deliver pairs — an undelivered ticket would stall the
+        merge by construction (the order gap is the point)."""
+        while True:
+            with self._cv:
+                self._raise_if_failed_locked()
+                if self._held:
+                    raise FrontDoorError(
+                        "front door is paused; resume() before flush()"
+                    )
+                if (
+                    self.delivered_batches == self.admitted_batches
+                    and not self._buffer
+                    and not self._summary
+                    and not self._applying
+                ):
+                    break
+                self._check_worker_locked()
+                self._cv.wait(_WAIT_S)
+        self._eng.flush()
+
+    def close(self, spill=False):
+        """Stop the front door and join the merge worker.
+
+        Default: drain everything contiguously deliverable, then stop
+        (the engine's pipeline is flushed too). spill=True instead
+        EXTRACTS the not-yet-applied state and returns it:
+        ``{"summary": [(producer, winners, losers), ...],
+        "queued": [(seq, producer, winners, losers), ...]}`` — summary
+        segments in shed order, queued batches in sequence order, the
+        exact structure `resubmit_spilled` re-admits after a restart
+        (persist it next to the engine snapshot's own queue spill).
+        Spilled batches are counted on the existing producer-labeled
+        spill counters, never as dropped."""
+        spilled = None
+        obs = self._obs()
+        with self._cv:
+            if spill:
+                spilled = {
+                    "summary": [
+                        (p, w, l) for p, w, l in self._summary
+                    ],
+                    "queued": [
+                        (seq, t.producer, t.winners, t.losers)
+                        for seq, t in sorted(self._buffer.items())
+                    ],
+                }
+                per_producer = {}
+                for p, w, _l in spilled["summary"]:
+                    b, m = per_producer.get(p, (0, 0))
+                    per_producer[p] = (b + 1, m + int(w.shape[0]))
+                for _seq, p, w, _l in spilled["queued"]:
+                    b, m = per_producer.get(p, (0, 0))
+                    per_producer[p] = (b + 1, m + int(w.shape[0]))
+                for p, (b, m) in sorted(per_producer.items()):
+                    obs.counter(
+                        "arena_pipeline_spilled_batches_total", producer=p
+                    ).inc(b)
+                    obs.counter(
+                        "arena_pipeline_spilled_matches_total", producer=p
+                    ).inc(m)
+                    obs.event("spill", producer=p, batches=b, matches=m)
+                self._buffer.clear()
+                self._summary.clear()
+                self._summary_matches = 0
+                self._producer_pending = {}
+            self._closed = True
+            self._held = False
+            self._cv.notify_all()
+        self._thread.join(timeout=10.0)
+        if not spill:
+            with self._cv:
+                self._raise_if_failed_locked()
+            self._eng.flush()
+        return spilled
+
+    def resubmit_spilled(self, spilled):
+        """Re-admit a `close(spill=True)` extraction in deterministic
+        order: summary segments first (as INDIVIDUAL batches — the
+        restart restores the granularity pending coalescing would have
+        cost), then the queued batches in their spilled sequence
+        order, each under its original producer label."""
+        for producer, w, l in spilled["summary"]:
+            self.submit(w, l, producer=producer)
+        for _seq, producer, w, l in spilled["queued"]:
+            self.submit(w, l, producer=producer)

@@ -1,0 +1,217 @@
+"""Wire protocol: routes, the response envelope, and a tiny client.
+
+The HTTP/JSON layer is deliberately thin: `ArenaServer.query()` already
+returns JSON-shaped dicts, so the wire tier's whole protocol job is
+(1) mapping paths/queries onto the batched query API, (2) validating
+the submit body into the int32 arrays the front door admits, and
+(3) the ENVELOPE — every JSON response carries the staleness
+``watermark`` and the request's ``trace_id`` side by side (ROADMAP
+item 1: the trace id goes in the response next to the watermark, so a
+slow or stale response is one `tracer.trace(id)` away from its story).
+
+Errors follow the repo's verdict discipline: a malformed request is a
+structured JSON error with the right status (400/404/405), never a
+stack trace; the status codes land in the same
+`arena_http_requests_total{endpoint=,status=}` counters as successes.
+
+`WireClient` is the stdlib consumer half (persistent
+`http.client.HTTPConnection`, one reconnect on a dropped keep-alive) —
+what the frontend bench's producer/reader threads and the wire tests
+drive the real server with. No jax imports anywhere in this module.
+"""
+
+import json
+import http.client
+import urllib.parse
+
+import numpy as np
+
+ENDPOINTS = (
+    "leaderboard", "player", "h2h", "submit", "stats", "healthz",
+)
+
+# Default leaderboard page when the query string omits one.
+DEFAULT_PAGE_LIMIT = 50
+
+
+class ProtocolError(ValueError):
+    """A malformed request: carries the HTTP status it must map to."""
+
+    def __init__(self, status, message):
+        super().__init__(message)
+        self.status = status
+
+
+def _query_int(params, key, default=None):
+    raw = params.get(key, [None])[0]
+    if raw is None:
+        if default is None:
+            raise ProtocolError(400, f"missing required query param {key!r}")
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ProtocolError(
+            400, f"query param {key!r} must be an integer, got {raw!r}"
+        ) from None
+
+
+def parse_path(method, path):
+    """Map (method, raw path) onto (endpoint, params) or raise
+    `ProtocolError` with the status an unmatched request deserves:
+    404 for an unknown path, 405 for a known path with the wrong
+    method, 400 for malformed params."""
+    split = urllib.parse.urlsplit(path)
+    parts = [p for p in split.path.split("/") if p]
+    params = urllib.parse.parse_qs(split.query)
+    route = parts[0] if parts else ""
+    if route == "healthz" and len(parts) == 1:
+        endpoint, want = "healthz", "GET"
+        parsed = {}
+    elif route == "stats" and len(parts) == 1:
+        endpoint, want = "stats", "GET"
+        parsed = {}
+    elif route == "leaderboard" and len(parts) == 1:
+        endpoint, want = "leaderboard", "GET"
+        parsed = {
+            "offset": _query_int(params, "offset", 0),
+            "limit": _query_int(params, "limit", DEFAULT_PAGE_LIMIT),
+        }
+    elif route == "player" and len(parts) == 2:
+        endpoint, want = "player", "GET"
+        try:
+            parsed = {"player": int(parts[1])}
+        except ValueError:
+            raise ProtocolError(
+                400, f"player id must be an integer, got {parts[1]!r}"
+            ) from None
+    elif route == "h2h" and len(parts) == 1:
+        endpoint, want = "h2h", "GET"
+        parsed = {"a": _query_int(params, "a"), "b": _query_int(params, "b")}
+    elif route == "submit" and len(parts) == 1:
+        endpoint, want = "submit", "POST"
+        parsed = {}
+    else:
+        raise ProtocolError(404, f"no such endpoint: {split.path!r}")
+    if method != want:
+        raise ProtocolError(
+            405, f"/{endpoint} requires {want}, got {method}"
+        )
+    return endpoint, parsed
+
+
+def parse_submit_body(raw):
+    """Validate a submit body into (winners, losers, producer).
+
+    The body is ``{"winners": [ints], "losers": [ints],
+    "producer": "name"?}``; array-shape/range validation beyond this
+    (equal length, ids in range) happens at admission in the front
+    door, where the engine's own reject posture applies."""
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise ProtocolError(400, f"submit body is not JSON: {exc}") from None
+    if not isinstance(doc, dict):
+        raise ProtocolError(400, "submit body must be a JSON object")
+    producer = doc.get("producer", "local")
+    if not isinstance(producer, str) or not producer:
+        raise ProtocolError(
+            400, f"producer must be a non-empty string, got {producer!r}"
+        )
+    out = []
+    for key in ("winners", "losers"):
+        ids = doc.get(key)
+        if not isinstance(ids, list) or not all(
+            isinstance(i, int) and not isinstance(i, bool) for i in ids
+        ):
+            raise ProtocolError(
+                400, f"submit field {key!r} must be a list of integers"
+            )
+        out.append(np.asarray(ids, np.int32))
+    return out[0], out[1], producer
+
+
+def make_response(payload, *, watermark, trace_id):
+    """The response envelope: the payload dict plus the staleness
+    watermark and the request's trace id, side by side in EVERY JSON
+    response (the wire contract the tier-1 wire tests pin; a payload's
+    own watermark/trace_id fields are replaced by the authoritative
+    pair so no endpoint can drift)."""
+    out = {
+        k: v for k, v in payload.items() if k not in ("watermark", "trace_id")
+    }
+    out["watermark"] = watermark
+    out["trace_id"] = trace_id
+    return out
+
+
+class WireClient:
+    """Minimal persistent-connection JSON client for the wire tier.
+
+    One `http.client.HTTPConnection` reused across requests (keep-
+    alive); a dropped connection is rebuilt once per request. Returns
+    `(status, payload)` — payload is the decoded JSON body, or the
+    raw text for non-JSON responses (`/stats`)."""
+
+    def __init__(self, host, port, timeout=30.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self._conn = None
+
+    def _connect(self):
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+        return self._conn
+
+    def _request(self, method, path, body=None):
+        headers = {}
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        for attempt in (0, 1):
+            conn = self._connect()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+                content_type = resp.getheader("Content-Type", "")
+                if content_type.startswith("application/json"):
+                    payload = json.loads(raw.decode("utf-8"))
+                else:
+                    payload = raw.decode("utf-8")
+                return resp.status, payload, dict(resp.getheaders())
+            except (http.client.HTTPException, ConnectionError, OSError):
+                self.close()
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    def get(self, path):
+        status, payload, _headers = self._request("GET", path)
+        return status, payload
+
+    def get_with_headers(self, path):
+        return self._request("GET", path)
+
+    def post(self, path, doc):
+        status, payload, _headers = self._request("POST", path, body=doc)
+        return status, payload
+
+    def submit(self, winners, losers, producer="local"):
+        """POST one batch to /submit (ids coerced to plain ints)."""
+        return self.post("/submit", {
+            "winners": [int(i) for i in np.asarray(winners).tolist()],
+            "losers": [int(i) for i in np.asarray(losers).tolist()],
+            "producer": producer,
+        })
+
+    def close(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            finally:
+                self._conn = None

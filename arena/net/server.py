@@ -1,0 +1,208 @@
+"""HTTP/JSON wire server: the network face of `ArenaServer`.
+
+A stdlib `ThreadingHTTPServer` (no new dependencies) exposing the
+already-JSON-shaped serving responses over six endpoints:
+
+    GET  /healthz                     liveness + applied watermark
+    GET  /leaderboard?offset=&limit=  one descending-rating page
+    GET  /player/{id}                 one player's rating row (+ CI)
+    GET  /h2h?a=&b=                   Elo P(a beats b)
+    POST /submit                      admit one batch at the front door
+    GET  /stats                       the registry's Prometheus render()
+
+One request reads ONE immutable `ServingView` (the `ArenaServer.query`
+contract — the handler never touches engine internals), and every JSON
+response carries the staleness ``watermark`` with the request's
+``trace_id`` next to it (`arena.net.protocol.make_response`); `/stats`
+is Prometheus text, so its pair rides the `X-Arena-Watermark` /
+`X-Arena-Trace-Id` headers instead (all endpoints set both headers).
+
+Each request runs under a `net.<endpoint>` root span, so the serving
+spans it triggers (view build, query) — and, for `/submit`, the whole
+cross-thread admission → merge → pack → dispatch chain — reconstruct
+as one trace from the id in the response. Requests land in
+`arena_http_requests_total{endpoint=,status=}` and the per-endpoint
+latency histogram through the server's ONE registry (the same schema
+`stats()`, `/stats`, and the frontend bench read).
+
+Threading: `ThreadingHTTPServer` gives one daemon thread per
+connection (HTTP/1.1 keep-alive, so a frontend holds one thread, not
+one per request). Query handlers are read-only against immutable
+views; `/submit` serializes through the front door's admission lock.
+The jitted work never runs on a handler thread — submit hands the
+batch to the front door's merge worker and returns the ticket.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from arena.net import protocol
+
+# Submit responses are 202 (accepted into the total order, applied
+# asynchronously) — the wire mirrors the front door's semantics.
+STATUS_ACCEPTED = 202
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # The wire tier logs through the metrics registry, not stderr.
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        return None
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    # --- request plumbing --------------------------------------------
+
+    def _handle(self, method):
+        wire = self.server.wire
+        obs = wire.obs
+        t0 = time.perf_counter()
+        endpoint = "unmatched"
+        trace_id = 0
+        # Drain the request body FIRST, unconditionally: on a keep-
+        # alive connection an unread body would be parsed as the next
+        # request's request line (every error path would poison the
+        # connection behind it).
+        length = int(self.headers.get("Content-Length") or 0)
+        body_raw = self.rfile.read(length) if length else b""
+        try:
+            endpoint, params = protocol.parse_path(method, self.path)
+            with obs.span(f"net.{endpoint}") as root:
+                trace_id = root.trace_id
+                status, payload = self._dispatch(
+                    wire, endpoint, params, body_raw
+                )
+        except protocol.ProtocolError as exc:
+            status, payload = exc.status, {"error": str(exc)}
+        except ValueError as exc:
+            # The serving/admission reject posture (bad ids, malformed
+            # arrays): the caller's fault, named, no state change.
+            status, payload = 400, {"error": str(exc)}
+        except Exception as exc:  # noqa: BLE001 — a handler crash must
+            # degrade to a structured 500, never a dropped connection.
+            status, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
+        watermark = wire.server.engine.matches_applied
+        if payload is None:  # /stats: Prometheus text, envelope in headers
+            body = wire.render().encode("utf-8")
+            content_type = "text/plain; version=0.0.4"
+        else:
+            body = json.dumps(
+                protocol.make_response(
+                    payload, watermark=watermark, trace_id=trace_id
+                )
+            ).encode("utf-8")
+            content_type = "application/json"
+        try:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Arena-Watermark", str(watermark))
+            self.send_header("X-Arena-Trace-Id", str(trace_id))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionError):
+            status = 499  # client went away mid-response (nginx's code)
+        obs.counter(
+            "arena_http_requests_total", endpoint=endpoint, status=str(status)
+        ).inc()
+        obs.histogram(
+            "arena_http_request_latency_seconds", endpoint=endpoint
+        ).record(time.perf_counter() - t0, trace_id=trace_id)
+
+    def _dispatch(self, wire, endpoint, params, body_raw):
+        srv = wire.server
+        if endpoint == "healthz":
+            return 200, {
+                "status": "ok",
+                "players": srv.engine.num_players,
+                "matches_ingested": srv.engine.matches_ingested,
+            }
+        if endpoint == "stats":
+            return 200, None  # body rendered from the registry
+        if endpoint == "leaderboard":
+            return 200, srv.query(
+                leaderboard=(params["offset"], params["limit"])
+            )
+        if endpoint == "player":
+            return 200, srv.query(players=[params["player"]])
+        if endpoint == "h2h":
+            return 200, srv.query(pairs=[(params["a"], params["b"])])
+        if endpoint == "submit":
+            return self._submit(wire, body_raw)
+        raise protocol.ProtocolError(404, f"no such endpoint: {endpoint!r}")
+
+    def _submit(self, wire, body_raw):
+        frontdoor = wire.frontdoor
+        if frontdoor is None:
+            raise protocol.ProtocolError(
+                503, "this server has no front door (read-only replica)"
+            )
+        winners, losers, producer = protocol.parse_submit_body(body_raw)
+        seq = frontdoor.submit(winners, losers, producer=producer)
+        return STATUS_ACCEPTED, {
+            "seq": seq,
+            "producer": producer,
+            "matches": int(winners.shape[0]),
+            "pending_batches": frontdoor.pending_batches(),
+        }
+
+
+class ArenaHTTPServer:
+    """The wire tier: one `ThreadingHTTPServer` over one `ArenaServer`
+    (+ optionally one `FrontDoor` for the submit path; without one the
+    server is a read-only replica and /submit answers 503).
+
+    `port=0` binds an ephemeral port (tests/bench); `self.port` is the
+    bound one either way. `start()` serves on a daemon thread;
+    `close()` shuts down and joins. Usable as a context manager."""
+
+    def __init__(self, server, frontdoor=None, host="127.0.0.1", port=0):
+        self.server = server
+        self.frontdoor = frontdoor
+        self.obs = server.obs
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.wire = self
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def render(self):
+        """The /stats body: the registry's Prometheus exposition."""
+        return self.obs.render()
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("wire server already started")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="arena-wire-server",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def close(self):
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -306,6 +306,21 @@ class Registry:
                 total += metric.value
         return total
 
+    def counter_by_label(self, name, key):
+        """One counter name's values GROUPED by one label key — e.g.
+        requests by `endpoint` or sheds by `policy` — summed across
+        the other labels; rows missing the key fold under ``""``. The
+        middle ground between `counter_sum`'s single number and
+        `dump()`'s full label split — what `ArenaServer.stats()`
+        reports the wire tier's per-endpoint/per-policy counts from
+        (one schema, one registry)."""
+        out = {}
+        for (n, _labels), metric in self._sorted_metrics():
+            if n == name and isinstance(metric, Counter):
+                value = metric.labels.get(key, "")
+                out[value] = out.get(value, 0) + metric.value
+        return out
+
     def render(self):
         """Prometheus text exposition (the endpoint-ready form)."""
         lines = []
@@ -430,6 +445,9 @@ class NullRegistry:
 
     def counter_sum(self, name):
         return 0
+
+    def counter_by_label(self, name, key):
+        return {}
 
     def render(self):
         return ""

@@ -211,7 +211,7 @@ class IngestPipeline:
 
     # --- producer side ----------------------------------------------
 
-    def submit(self, winners, losers):
+    def submit(self, winners, losers, producer=None):
         """Enqueue one VALIDATED batch (int32 arrays, ids in range).
 
         Validation happens in `ArenaEngine.ingest_async` on the calling
@@ -219,7 +219,14 @@ class IngestPipeline:
         state change. While waiting on a full queue (block policy) the
         caller dispatches ready work — backpressure can never deadlock
         against a packer waiting for a staging slot.
+
+        `producer` overrides the pipeline's own label for THIS batch's
+        submit-path counters — the multi-producer front door
+        (`arena/net/frontdoor.py`) feeds one pipeline but counts each
+        batch under its original producer, so the per-producer streams
+        stay visible in the one metric schema.
         """
+        label = producer if producer is not None else self.producer
         ctx = trace_context.current()  # the batch.submit root (or None)
         wait_t0 = None
         while True:
@@ -251,7 +258,7 @@ class IngestPipeline:
                     self._cv.wait(_WAIT_S)
         obs = self._obs()
         obs.counter(
-            "arena_pipeline_submitted_batches_total", producer=self.producer
+            "arena_pipeline_submitted_batches_total", producer=label
         ).inc()
         obs.gauge(
             "arena_pipeline_queue_depth", producer=self.producer
@@ -262,7 +269,7 @@ class IngestPipeline:
             # work counts as waiting: the caller could not enqueue).
             waited = time.perf_counter() - wait_t0
             obs.histogram(
-                "arena_pipeline_enqueue_wait_seconds", producer=self.producer
+                "arena_pipeline_enqueue_wait_seconds", producer=label
             ).record(waited)
             obs.tracer.record_span("pipeline.enqueue_wait", wait_t0, waited)
         # Overlap: opportunistically dispatch whatever the packer has

@@ -500,6 +500,22 @@ class ArenaServer:
                     "arena_pipeline_spilled_matches_total"
                 ),
             },
+            # Wire-tier counters (PR 9), through the SAME registry the
+            # HTTP handlers write and /stats renders — requests by
+            # endpoint/status plus the shed split by policy. Zeros
+            # until a wire server runs; one schema either way.
+            "net": {
+                "requests": reg.counter_sum("arena_http_requests_total"),
+                "requests_by_endpoint": reg.counter_by_label(
+                    "arena_http_requests_total", "endpoint"
+                ),
+                "requests_by_status": reg.counter_by_label(
+                    "arena_http_requests_total", "status"
+                ),
+                "shed_batches_by_policy": reg.counter_by_label(
+                    "arena_pipeline_dropped_batches_total", "policy"
+                ),
+            },
             "obs": self.obs.dump(),
         }
 
@@ -587,6 +603,12 @@ class ArenaServer:
         num_players = view.ratings.size
         out = {
             "watermark": view.watermark,
+            # The request's trace id rides NEXT TO the watermark in
+            # every response (ROADMAP item 1): a stale or slow answer
+            # is one tracer.trace(id) away from its causal story. The
+            # wire tier's envelope re-stamps the same pair (the net
+            # root span shares this trace).
+            "trace_id": qspan.trace_id,
             "matches_ingested": view.matches_ingested,
             "staleness": self._staleness(view),
             "stale": stale,

@@ -436,6 +436,49 @@ MUTATIONS = (
         "test_watchdog_flags_regressions_not_improvements",
     ),
     (
+        "net-sequence-order-ignored-at-merge",
+        "arena/net/frontdoor.py",
+        "        item = self._buffer.pop(self._next_apply, None)\n"
+        "        if item is None:\n"
+        "            return None\n"
+        "        self._next_apply = item.seq + 1",
+        "        if not self._buffer:\n"
+        "            return None\n"
+        "        item = self._buffer.pop(next(iter(self._buffer)))\n"
+        "        self._next_apply = item.seq + 1",
+        "the front door's merge must apply batches in SEQUENCE order (the "
+        "admission-assigned total order), never in the order batch bodies "
+        "happened to arrive in the buffer — arrival order under N producers "
+        "is a race, not a replayable stream, and breaks the async==sync "
+        "bit-exact equivalence property — killed by "
+        "test_merge_applies_sequence_order_not_arrival_order",
+    ),
+    (
+        "net-shed-coalesce-drops-matches-silently",
+        "arena/net/frontdoor.py",
+        '            with obs.span("frontdoor.summary_apply"):\n'
+        "                self._eng.ingest_async(w, l, producer=SUMMARY_PRODUCER)",
+        '            with obs.span("frontdoor.summary_apply"):\n'
+        "                pass",
+        "bounded-degradation shedding PRESERVES the shed batches' matches as "
+        "one summary update; omitting the summary apply turns coalescing "
+        "into silent data loss (exactly the all-or-nothing drop the policy "
+        "replaces) while every counter still reads 'coalesced' — killed by "
+        "test_shed_batches_coalesce_into_summary_update (engine match count "
+        "and the bit-exact replay both break)",
+    ),
+    (
+        "net-wire-response-omits-staleness-watermark",
+        "arena/net/protocol.py",
+        '    out["watermark"] = watermark\n    out["trace_id"] = trace_id',
+        '    out["trace_id"] = trace_id',
+        "every wire response must carry the staleness watermark next to the "
+        "request's trace id (ROADMAP item 1's response contract); dropping "
+        "it from the envelope leaves clients unable to tell fresh answers "
+        "from stale ones — killed by "
+        "test_every_wire_response_carries_watermark_and_trace_id",
+    ),
+    (
         "lint-donation-poisoning-dropped",
         "arena/analysis/jaxlint.py",
         "                            if target_name:\n"

@@ -35,6 +35,7 @@ CORPUS_EXPECTED = {
     "bad_timing.py": {"timing-without-block"},
     "bad_timing_span.py": {"timing-without-block"},
     "bad_jnp_host.py": {"jnp-on-host-path"},
+    "bad_handler_host_path.py": {"jnp-on-host-path"},
     "bad_sharding_spec.py": {"sharding-spec-arity"},
 }
 
@@ -77,10 +78,10 @@ def test_host_sync_rule_names_each_call_form():
 
 def test_default_targets_cover_the_ingest_and_pipeline_modules():
     """The seven rules gate every NEW hot path: arena/ingest.py,
-    arena/pipeline.py, arena/serving.py and the arena/obs/ package
-    must be inside the default-target walk (so `python -m
-    arena.analysis` and the clean-tree test both lint them) and must
-    themselves lint clean."""
+    arena/pipeline.py, arena/serving.py, the arena/obs/ package, and
+    the arena/net/ wire tier must be inside the default-target walk
+    (so `python -m arena.analysis` and the clean-tree test both lint
+    them) and must themselves lint clean."""
     walked = {
         str(f) for f in jaxlint.iter_python_files(jaxlint.default_targets())
     }
@@ -88,11 +89,31 @@ def test_default_targets_cover_the_ingest_and_pipeline_modules():
         "ingest.py", "pipeline.py", "serving.py",
         "obs/__init__.py", "obs/metrics.py", "obs/tracing.py",
         "obs/context.py", "obs/debug.py", "obs/regress.py",
+        "net/__init__.py", "net/protocol.py", "net/frontdoor.py",
+        "net/server.py",
     ):
         path = str(REPO / "arena" / mod)
         assert path in walked, f"default targets no longer cover arena/{mod}"
         findings = jaxlint.lint_paths([path])
         assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_wire_handler_hot_path_lints_clean_while_corpus_twin_fires():
+    """The corpus carries the request-handler-shaped hazard
+    (bad_handler_host_path.py: jnp sort on the per-request host path —
+    flagged), and the REAL wire handlers are pinned NOT to trip it:
+    arena/net/server.py answers from prebuilt NumPy views, stdlib
+    only."""
+    corpus_findings = jaxlint.lint_paths(
+        [str(CORPUS / "bad_handler_host_path.py")]
+    )
+    assert {f.rule for f in corpus_findings} == {"jnp-on-host-path"}
+    real = jaxlint.lint_paths([
+        str(REPO / "arena" / "net" / "server.py"),
+        str(REPO / "arena" / "net" / "frontdoor.py"),
+        str(REPO / "arena" / "net" / "protocol.py"),
+    ])
+    assert real == [], "\n".join(f.format() for f in real)
 
 
 def test_obs_span_api_does_not_trip_the_timing_rule():

@@ -408,6 +408,97 @@ def test_soak_bench_full_size_stays_compile_free():
     assert result["soak"]["interval_refreshes"] == 4
 
 
+FRONTEND_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "frontend",
+    "ARENA_BENCH_MATCHES": "20000",
+    "ARENA_BENCH_DELTA": "500",
+    "ARENA_BENCH_PLAYERS": "64",
+    "ARENA_BENCH_BATCH": "2048",
+    "ARENA_BENCH_FRONTEND_BATCHES": "4",
+    "ARENA_BENCH_OVERLOAD_BATCHES": "6",
+}
+
+
+def test_frontend_bench_smoke_contract():
+    """ARENA_BENCH_MODE=frontend through the real entrypoint: one JSON
+    line, rc 0, the arena_frontend metric with N=4 producers + M=2
+    readers over REAL localhost HTTP — ratings bit-exact to the sync
+    sequence-order replay of the applied log (max_rating_diff 0.0),
+    zero steady-state compiles across all threads, and the forced-
+    overload phase actually shedding: coalesced batches counted under
+    policy="coalesce", staleness held within the configured bound,
+    every shed trace ended with its dropped marker, zero dangling
+    orphans at quiescence."""
+    result = run_bench(FRONTEND_SMOKE_ENV, timeout=300)
+    assert result["metric"] == "arena_frontend"
+    assert result["unit"] == "wire_queries_per_s"
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["value"] > 0
+    assert result["params"]["producers"] == 4
+    assert result["params"]["readers"] == 2
+    fe = result["frontend"]
+    assert fe["wire_queries"] > 0
+    assert fe["ingest_matches_per_s"] > 0
+    assert fe["steady_state_new_compiles"] == 0
+    # The wire really carried the traffic: per-endpoint counters from
+    # the ONE registry (submits = warmup + phase-1 + overload).
+    assert fe["requests_by_endpoint"]["submit"] == 1 + 4 * 4 + 4 * 6
+    assert fe["requests_by_status"]["202"] == fe["requests_by_endpoint"]["submit"]
+    assert fe["requests_by_endpoint"]["leaderboard"] > 0
+    # The overload phase exercised the shedding policy, boundedly.
+    assert fe["shed_batches"] > 0
+    assert fe["shed_by_policy"]["coalesce"] == fe["shed_batches"]
+    assert fe["max_staleness_matches_seen"] <= fe["staleness_bound"]
+    assert fe["dropped_marker_spans"] >= fe["shed_batches"]
+    assert fe["trace_dangling_orphans"] == 0
+    assert fe["max_view_mass_dev"] < 0.5
+
+
+def test_frontend_bench_equivalence_gate_is_hard(tmp_path):
+    """The hard gate covers the wire path: with the tolerance forced
+    to 0 even a bit-exact run trips it (no diff is < 0) — the distinct
+    equivalence-failure line (frontend-mode unit, no throughput
+    fields), rc 2, and a flight-recorder bundle next to the verdict."""
+    result = run_bench(
+        {
+            **FRONTEND_SMOKE_ENV,
+            "ARENA_BENCH_TOL": "0",
+            "ARENA_DEBUG_DIR": str(tmp_path),
+        },
+        timeout=300,
+        expect_rc=2,
+    )
+    assert result["metric"] == "arena_bench_equivalence_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "wire_queries_per_s"
+    assert result["tolerance"] == 0.0
+    assert "exceeds tolerance" in result["error"]
+    assert "frontend" not in result
+    bundle = pathlib.Path(result["debug_bundle"])
+    assert bundle.parent == tmp_path
+    assert (bundle / "metrics.json").exists()
+
+
+@pytest.mark.slow
+def test_frontend_bench_full_size_over_real_http():
+    """The acceptance run at the acceptance size: 4 producers x 6 x
+    10k-match batches + 2 readers over real HTTP against the 100k
+    base — bit-exact sequence-order replay, zero steady-state
+    compiles, bounded shedding under forced overload."""
+    result = run_bench({"ARENA_BENCH_MODE": "frontend"}, timeout=600)
+    assert result["metric"] == "arena_frontend"
+    assert result["params"]["base_matches"] == 100_000
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["value"] > 0
+    fe = result["frontend"]
+    assert fe["steady_state_new_compiles"] == 0
+    assert fe["shed_batches"] > 0
+    assert fe["max_staleness_matches_seen"] <= fe["staleness_bound"]
+    assert fe["trace_dangling_orphans"] == 0
+
+
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     """The hard gate: with the tolerance forced to 0 the (real, tiny)
     float32-vs-float64 divergence trips it — one JSON line carrying the

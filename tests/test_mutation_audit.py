@@ -60,10 +60,11 @@ def test_mutations_cover_every_policed_surface():
     arena bench's async equivalence gate, since PR 5 the serving
     layer (silent-partial-restore, staleness policy, snapshot version
     gate), since PR 6 the observability layer (histogram bucket
-    semantics, stats() sentinel absorption, the soak hard gate), and
-    since PR 7 the diagnosis layer (exemplar bucket placement, the
-    flight recorder's registry dump, the watchdog's tolerance
-    direction)."""
+    semantics, stats() sentinel absorption, the soak hard gate), since
+    PR 7 the diagnosis layer (exemplar bucket placement, the flight
+    recorder's registry dump, the watchdog's tolerance direction), and
+    since PR 9 the network tier (sequence order at the merge, the
+    shed-coalesce summary update, the wire response envelope)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -76,6 +77,8 @@ def test_mutations_cover_every_policed_surface():
         "arena/obs/metrics.py",
         "arena/obs/debug.py",
         "arena/obs/regress.py",
+        "arena/net/frontdoor.py",
+        "arena/net/protocol.py",
     }
 
 
@@ -108,6 +111,8 @@ def _fake_sources_only(dest):
         "arena/obs/metrics.py",
         "arena/obs/debug.py",
         "arena/obs/regress.py",
+        "arena/net/frontdoor.py",
+        "arena/net/protocol.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,369 @@
+"""Front-door contracts: the deterministic total order, bounded-
+degradation shedding, and crash-restart with spilled per-producer
+queues (arena/net/frontdoor.py).
+
+The property this file exists to police is ISSUE 9's: under N
+concurrent producers the applied stream is ONE well-defined sequence
+order, and replaying that order through synchronous single-producer
+`ingest()` lands on BIT-EXACT the same ratings — including under
+shedding (the coalesced summary is applied deterministically at the
+shed batches' position) and across a crash-restart that spills the
+per-producer queues. The mutation audit carries the
+sequence-order-ignored-at-merge and summary-update-omitted mutants;
+`test_merge_applies_sequence_order_not_arrival_order` and
+`test_shed_batches_coalesce_into_summary_update` are their named
+kills.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from arena.engine import ArenaEngine
+from arena.net import FrontDoor, FrontDoorError, POLICY_STALENESS
+from arena.obs import Observability
+
+PLAYERS = 32
+
+
+def make_batch(rng, n=40):
+    a = rng.integers(0, PLAYERS, n).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, PLAYERS - 1, n)) % PLAYERS).astype(np.int32)
+    return a, b
+
+
+def replay_sync(applied_log, num_players=PLAYERS):
+    """The equivalence anchor: the applied log through a fresh sync
+    single-producer engine, in order."""
+    eng = ArenaEngine(num_players)
+    for _kind, w, l in applied_log:
+        eng.ingest(w, l)
+    return np.asarray(eng.ratings)
+
+
+def test_merge_applies_sequence_order_not_arrival_order():
+    """Admission order (sequence numbers) is the total order — NOT the
+    order batch bodies happen to land in the buffer. Two tickets
+    delivered in REVERSED order must still apply in sequence order
+    (the merge waits for the gap), and the ratings must equal the
+    sequence-order sync replay. Elo is order-dependent, so an
+    arrival-order merge produces different ratings — the audit's
+    sequence-order-ignored-at-merge mutant dies here."""
+    rng = np.random.default_rng(7)
+    eng = ArenaEngine(PLAYERS)
+    fd = FrontDoor(eng, record_applied=True)
+    try:
+        wa, la = make_batch(rng)
+        wb, lb = make_batch(rng)
+        ta = fd.admit(wa, la, producer="a")  # seq 0
+        tb = fd.admit(wb, lb, producer="b")  # seq 1
+        assert (ta.seq, tb.seq) == (0, 1)
+        # Bodies land out of order: b first. The merge must NOT apply
+        # b — seq 0 has not been delivered yet.
+        fd.deliver(tb)
+        fd.deliver(ta)
+        fd.flush()
+    finally:
+        fd.close()
+    assert [kind for kind, _w, _l in fd.applied_log] == ["batch", "batch"]
+    applied_w = [w for _k, w, _l in fd.applied_log]
+    assert np.array_equal(applied_w[0], wa), "seq 0 must apply first"
+    assert np.array_equal(applied_w[1], wb)
+    assert np.array_equal(np.asarray(eng.ratings), replay_sync(fd.applied_log))
+    # The orders genuinely differ (the test would be vacuous otherwise).
+    eng_arrival = ArenaEngine(PLAYERS)
+    eng_arrival.ingest(wb, lb)
+    eng_arrival.ingest(wa, la)
+    assert not np.array_equal(
+        np.asarray(eng.ratings), np.asarray(eng_arrival.ratings)
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_n_producer_random_interleaving_is_bit_exact_to_sync_replay(seed):
+    """The headline property, 3 seeds x N=4 producer THREADS: random
+    batches, random thread interleavings, one front door — the applied
+    log is in admission order and replays bit-exact through a sync
+    single-producer engine."""
+    rng = np.random.default_rng(seed)
+    eng = ArenaEngine(PLAYERS)
+    fd = FrontDoor(eng, capacity=64, record_applied=True)
+    per_producer = [
+        [make_batch(rng, int(rng.integers(8, 64))) for _ in range(6)]
+        for _ in range(4)
+    ]
+
+    def producer(pid):
+        for w, l in per_producer[pid]:
+            fd.submit(w, l, producer=f"p{pid}")
+
+    threads = [
+        threading.Thread(target=producer, args=(p,)) for p in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        fd.flush()
+    finally:
+        fd.close()
+    total = sum(w.shape[0] for batches in per_producer for w, _l in batches)
+    assert eng.matches_ingested == total
+    assert len(fd.applied_log) == 24
+    assert np.array_equal(np.asarray(eng.ratings), replay_sync(fd.applied_log))
+
+
+def test_shed_batches_coalesce_into_summary_update():
+    """Over-capacity admissions shed the oldest batches — but their
+    MATCHES survive as one summary update applied at their slot in the
+    total order: nothing is lost, the engine's match count proves it,
+    and the replay (summary included) stays bit-exact. The audit's
+    summary-update-omitted mutant dies on the count assertion."""
+    rng = np.random.default_rng(3)
+    obs = Observability()
+    eng = ArenaEngine(PLAYERS, obs=obs)
+    fd = FrontDoor(
+        eng, capacity=3, max_staleness_matches=10_000, record_applied=True
+    )
+    batches = [make_batch(rng) for _ in range(9)]
+    try:
+        fd.pause()  # a stalled apply path: admissions pile up
+        for i, (w, l) in enumerate(batches):
+            fd.submit(w, l, producer=f"p{i % 2}")
+        assert fd.shed_batches == 6  # 9 admitted, 3 buffered
+        assert fd.dropped_matches == 0  # coalesced, not lost
+        fd.resume()
+        fd.flush()
+    finally:
+        fd.close()
+    total = sum(w.shape[0] for w, _l in batches)
+    # Every admitted match was applied: shed degraded granularity
+    # (6 batches became 1 summary), never data.
+    assert eng.matches_ingested == total
+    assert fd.summaries_applied == 1
+    kinds = [kind for kind, _w, _l in fd.applied_log]
+    assert kinds == ["summary", "batch", "batch", "batch"]
+    # The summary holds the shed batches' matches in sequence order.
+    summary_w = fd.applied_log[0][1]
+    assert np.array_equal(
+        summary_w, np.concatenate([w for w, _l in batches[:6]])
+    )
+    assert np.array_equal(np.asarray(eng.ratings), replay_sync(fd.applied_log))
+    # Shed traces ENDED with the existing marker, and none dangle.
+    markers = [s for s in obs.tracer.spans() if s.name == "pipeline.dropped"]
+    assert len(markers) == 6
+    assert not [
+        r for r, reason in obs.tracer.orphans() if reason == "dangling"
+    ]
+    # The policy-labeled drop counters carry the shed, per producer.
+    assert obs.registry.counter_by_label(
+        "arena_pipeline_dropped_batches_total", "policy"
+    ) == {"coalesce": 6}
+
+
+def test_staleness_bound_trims_oldest_summary_segments_counted():
+    """The summary's backlog is staleness-bounded: beyond
+    `max_staleness_matches` its OLDEST segments are dropped for real —
+    visible on the existing dropped-matches counter under
+    policy="staleness", never silent — and the ratings still replay
+    bit-exact over what WAS applied."""
+    rng = np.random.default_rng(4)
+    obs = Observability()
+    eng = ArenaEngine(PLAYERS, obs=obs)
+    fd = FrontDoor(
+        eng, capacity=2, max_staleness_matches=80, record_applied=True
+    )
+    batches = [make_batch(rng, 40) for _ in range(10)]
+    try:
+        fd.pause()
+        for w, l in batches:
+            fd.submit(w, l, producer="solo")
+        # 10 admitted: 2 buffered, 8 shed; the summary holds at most
+        # 80 matches = the NEWEST 2 shed batches; 6 x 40 dropped.
+        assert fd.shed_batches == 8
+        assert fd.dropped_matches == 6 * 40
+        assert fd._summary_matches <= 80
+        fd.resume()
+        fd.flush()
+    finally:
+        fd.close()
+    assert eng.matches_ingested == fd.admitted_matches - fd.dropped_matches
+    assert np.array_equal(np.asarray(eng.ratings), replay_sync(fd.applied_log))
+    # The trimmed summary kept the NEWEST shed segments (6 and 7), so
+    # freshness degraded from the OLD end.
+    summary_w = next(w for kind, w, _l in fd.applied_log if kind == "summary")
+    assert np.array_equal(
+        summary_w, np.concatenate([batches[6][0], batches[7][0]])
+    )
+    by_policy = obs.registry.counter_by_label(
+        "arena_pipeline_dropped_matches_total", "policy"
+    )
+    assert by_policy.get(POLICY_STALENESS) == 6 * 40
+    assert fd.staleness_matches() == 0  # quiescent: fully caught up
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_crash_restart_with_spilled_per_producer_queues_is_bit_exact(
+    seed, tmp_path
+):
+    """Crash mid-stream with batches still queued per producer: the
+    spill (summary segments + queued batches in sequence order, each
+    under its producer label) persists to disk, a restarted front door
+    re-admits it in the same deterministic order, and the final
+    ratings are bit-exact to an uninterrupted run over the same
+    stream."""
+    rng = np.random.default_rng(100 + seed)
+    stream = [
+        (make_batch(rng, int(rng.integers(16, 48))), f"p{i % 3}")
+        for i in range(14)
+    ]
+    half = 7
+
+    # --- the uninterrupted comparator --------------------------------
+    eng_ref = ArenaEngine(PLAYERS)
+    fd_ref = FrontDoor(eng_ref, capacity=64)
+    for (w, l), producer in stream:
+        fd_ref.submit(w, l, producer=producer)
+    fd_ref.flush()
+    fd_ref.close()
+
+    # --- the crashing run: first half applied, second half queued ----
+    eng1 = ArenaEngine(PLAYERS)
+    fd1 = FrontDoor(eng1, capacity=64, max_staleness_matches=10_000)
+    for (w, l), producer in stream[:half]:
+        fd1.submit(w, l, producer=producer)
+    fd1.flush()
+    fd1.pause()  # the "crash": the apply path stops mid-stream
+    # Tighten the buffer so the stalled second half also exercises the
+    # coalesce path: part of the spill arrives as summary segments.
+    fd1.set_policy(capacity=4)
+    for (w, l), producer in stream[half:]:
+        fd1.submit(w, l, producer=producer)
+    spilled = fd1.close(spill=True)
+    assert spilled["queued"] or spilled["summary"]
+    # The spill keeps per-producer identity and sequence order.
+    seqs = [seq for seq, _p, _w, _l in spilled["queued"]]
+    assert seqs == sorted(seqs)
+    producers_seen = {p for _s, p, _w, _l in spilled["queued"]} | {
+        p for p, _w, _l in spilled["summary"]
+    }
+    assert len(producers_seen) >= 2
+    applied_before_crash = eng1.matches_ingested
+
+    # Persist the spill like a snapshot sidecar and reload it.
+    arrays = {}
+    summary_meta = []
+    for i, (p, w, l) in enumerate(spilled["summary"]):
+        arrays[f"sw{i}"], arrays[f"sl{i}"] = w, l
+        summary_meta.append(p)
+    queued_meta = []
+    for i, (seq, p, w, l) in enumerate(spilled["queued"]):
+        arrays[f"qw{i}"], arrays[f"ql{i}"] = w, l
+        queued_meta.append((seq, p))
+    np.savez(tmp_path / "spill.npz", **arrays)
+    loaded = np.load(tmp_path / "spill.npz")
+    reloaded = {
+        "summary": [
+            (p, loaded[f"sw{i}"], loaded[f"sl{i}"])
+            for i, p in enumerate(summary_meta)
+        ],
+        "queued": [
+            (seq, p, loaded[f"qw{i}"], loaded[f"ql{i}"])
+            for i, (seq, p) in enumerate(queued_meta)
+        ],
+    }
+
+    # --- the restarted run -------------------------------------------
+    # (Engine state restart is the serving snapshot's job, PR 5-tested;
+    # here the restarted engine replays the applied prefix, then the
+    # front door re-admits the spill in deterministic order.)
+    eng2 = ArenaEngine(PLAYERS)
+    applied = 0
+    for (w, l), _producer in stream:
+        if applied >= applied_before_crash:
+            break
+        eng2.ingest(w, l)
+        applied += w.shape[0]
+    assert applied == applied_before_crash
+    fd2 = FrontDoor(eng2, capacity=64)
+    fd2.resubmit_spilled(reloaded)
+    fd2.flush()
+    fd2.close()
+    assert np.array_equal(np.asarray(eng2.ratings), np.asarray(eng_ref.ratings))
+    assert eng2.matches_ingested == eng_ref.matches_ingested
+
+
+def test_per_producer_streams_keep_the_producer_label():
+    """The PR 7 metric schema holds under the front door: submitted
+    batches are counted under their ORIGINAL producer label (the
+    per-producer streams stay visible), drops and queue depth ride the
+    same names, nothing was renamed."""
+    rng = np.random.default_rng(5)
+    obs = Observability()
+    eng = ArenaEngine(PLAYERS, obs=obs)
+    fd = FrontDoor(eng, capacity=64)
+    try:
+        for i in range(6):
+            w, l = make_batch(rng)
+            fd.submit(w, l, producer=f"frontend-{i % 3}")
+        fd.flush()
+    finally:
+        fd.close()
+    by_producer = obs.registry.counter_by_label(
+        "arena_pipeline_submitted_batches_total", "producer"
+    )
+    assert by_producer == {
+        "frontend-0": 2, "frontend-1": 2, "frontend-2": 2,
+    }
+    assert obs.registry.gauge(
+        "arena_pipeline_queue_depth", producer="frontend-0"
+    ).value >= 0.0
+
+
+def test_admission_rejects_malformed_batches_with_no_state_change():
+    eng = ArenaEngine(PLAYERS)
+    fd = FrontDoor(eng)
+    try:
+        with pytest.raises(ValueError):
+            fd.submit(np.array([0, 1], np.int32), np.array([1], np.int32))
+        with pytest.raises(ValueError):
+            fd.submit(
+                np.array([PLAYERS], np.int32), np.array([0], np.int32)
+            )
+        with pytest.raises(ValueError):
+            fd.submit(np.array([0], np.int32), np.array([1], np.int32),
+                      producer="")
+        assert fd.admitted_batches == 0
+        assert eng.matches_ingested == 0
+    finally:
+        fd.close()
+
+
+def test_merge_worker_error_surfaces_on_flush_not_a_hang():
+    """A dead merge worker must raise FrontDoorError at the next
+    flush/submit, never hang the caller (the pipeline's liveness
+    discipline, inherited)."""
+    rng = np.random.default_rng(6)
+    eng = ArenaEngine(PLAYERS)
+    fd = FrontDoor(eng)
+
+    def boom(*args, **kwargs):
+        raise RuntimeError("apply path died")
+
+    eng.ingest_async = boom
+    w, l = make_batch(rng)
+    fd.submit(w, l)
+    with pytest.raises(FrontDoorError, match="merge worker"):
+        fd.flush()
+    with pytest.raises(FrontDoorError):
+        fd.submit(w, l)
+
+
+def test_closed_front_door_rejects_submissions():
+    eng = ArenaEngine(PLAYERS)
+    fd = FrontDoor(eng)
+    fd.close()
+    with pytest.raises(FrontDoorError, match="closed"):
+        fd.submit(np.array([0], np.int32), np.array([1], np.int32))

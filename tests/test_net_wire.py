@@ -1,0 +1,263 @@
+"""Wire-tier contracts: the HTTP/JSON protocol over a REAL server
+(arena/net/server.py, arena/net/protocol.py).
+
+Every test here drives an actual `ThreadingHTTPServer` on an ephemeral
+localhost port through `WireClient` — the same stack the frontend
+bench's producers and readers use. The envelope contract (staleness
+watermark + request trace id side by side in EVERY JSON response) is
+this file's reason to exist; the mutation audit carries the
+wire-response-omits-staleness-watermark mutant and
+`test_every_wire_response_carries_watermark_and_trace_id` is its named
+kill. One server is shared module-wide (session cost: one engine, one
+port), with per-test state asserted as deltas.
+"""
+
+import numpy as np
+import pytest
+
+from arena.net import (
+    ArenaHTTPServer,
+    FrontDoor,
+    ProtocolError,
+    WireClient,
+    make_response,
+    parse_path,
+    parse_submit_body,
+)
+from arena.obs import Observability
+from arena.serving import ArenaServer
+
+PLAYERS = 48
+
+
+@pytest.fixture(scope="module")
+def wire():
+    obs = Observability()
+    srv = ArenaServer(num_players=PLAYERS, max_staleness_matches=0, obs=obs)
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, PLAYERS, 400).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, PLAYERS - 1, 400)) % PLAYERS).astype(np.int32)
+    srv.engine.ingest(a, b)
+    frontdoor = FrontDoor(srv.engine, capacity=32, record_applied=True)
+    server = ArenaHTTPServer(srv, frontdoor=frontdoor).start()
+    client = WireClient(server.host, server.port)
+    yield server, client
+    client.close()
+    server.close()
+    frontdoor.close()
+    srv.close()
+
+
+# --- the envelope contract --------------------------------------------------
+
+
+def test_every_wire_response_carries_watermark_and_trace_id(wire):
+    """The ROADMAP item 1 contract: the staleness watermark and the
+    request's trace id ride side by side in EVERY JSON response —
+    query endpoints, submit, healthz, and even protocol errors. The
+    /stats Prometheus body carries the pair in headers instead (also
+    asserted). The audit's envelope mutant dies here."""
+    server, client = wire
+    json_paths = [
+        "/leaderboard?offset=0&limit=5",
+        "/player/3",
+        "/h2h?a=1&b=2",
+        "/healthz",
+        "/nope-not-an-endpoint",  # 404s keep the envelope too
+    ]
+    for path in json_paths:
+        _status, resp = client.get(path)
+        assert "watermark" in resp, f"{path} response lost the watermark"
+        assert "trace_id" in resp, f"{path} response lost the trace id"
+        assert resp["watermark"] == server.server.engine.matches_applied
+    status, resp = client.submit([0, 1], [2, 3], producer="envelope-test")
+    assert status == 202
+    assert "watermark" in resp and "trace_id" in resp
+    server.frontdoor.flush()
+    # Handled endpoints run under a net.<endpoint> root span: the
+    # trace id is real and resolves in the tracer.
+    _status, resp = client.get("/leaderboard?offset=0&limit=1")
+    assert resp["trace_id"] > 0
+    trace = server.obs.tracer.trace(resp["trace_id"])
+    assert any(s.name == "net.leaderboard" for s in trace)
+    assert any(s.name == "serve.query" for s in trace)
+    # /stats: Prometheus text body, envelope in headers.
+    status, text, headers = client.get_with_headers("/stats")
+    assert status == 200
+    assert headers["X-Arena-Watermark"] == str(
+        server.server.engine.matches_applied
+    )
+    assert int(headers["X-Arena-Trace-Id"]) > 0
+    assert "# TYPE arena_http_requests_total counter" in text
+
+
+def test_one_request_reads_one_view_and_matches_in_process_query(wire):
+    """The wire layer adds transport, not semantics: a /leaderboard
+    page equals the in-process `ArenaServer.query` page, row for row,
+    and /player//h2h match their query() parts."""
+    server, client = wire
+    srv = server.server
+    _status, over_wire = client.get("/leaderboard?offset=0&limit=10")
+    direct = srv.query(leaderboard=(0, 10))
+    assert over_wire["leaderboard"] == direct["leaderboard"]
+    assert over_wire["view_seq"] == direct["view_seq"]
+    _status, player = client.get("/player/7")
+    assert player["players"] == srv.query(players=[7])["players"]
+    _status, h2h = client.get("/h2h?a=3&b=4")
+    assert h2h["pairs"] == srv.query(pairs=[(3, 4)])["pairs"]
+    page = [row["rating"] for row in over_wire["leaderboard"]]
+    assert page == sorted(page, reverse=True)
+
+
+def test_submit_over_wire_lands_in_the_total_order(wire):
+    server, client = wire
+    frontdoor = server.frontdoor
+    before = server.server.engine.matches_ingested
+    seqs = []
+    for producer in ("wire-a", "wire-b"):
+        status, resp = client.submit(
+            [0, 1, 2], [3, 4, 5], producer=producer
+        )
+        assert status == 202
+        assert resp["matches"] == 3
+        assert resp["producer"] == producer
+        seqs.append(resp["seq"])
+    assert seqs[1] == seqs[0] + 1  # global sequence numbers, in order
+    frontdoor.flush()
+    assert server.server.engine.matches_ingested == before + 6
+
+
+def test_malformed_requests_are_structured_errors_not_crashes(wire):
+    """400/404/405 with a JSON error body (envelope included) — and
+    the handler thread survives to serve the next request."""
+    server, client = wire
+    cases = [
+        ("GET", "/player/not-an-int", 400),
+        ("GET", "/player/999999", 400),  # out of range: query reject
+        ("GET", "/h2h?a=1", 400),  # missing b
+        ("GET", "/leaderboard?offset=x", 400),
+        ("GET", "/unknown", 404),
+        ("GET", "/submit", 405),  # wrong method
+    ]
+    for method, path, want in cases:
+        status, resp = client.get(path) if method == "GET" else (None, None)
+        assert status == want, (path, status, resp)
+        assert "error" in resp and "watermark" in resp
+    status, resp = client.post("/submit", {"winners": "nope", "losers": []})
+    assert status == 400 and "winners" in resp["error"]
+    status, resp = client.post(
+        "/submit", {"winners": [0], "losers": [1], "producer": ""}
+    )
+    assert status == 400
+    # Out-of-range ids are rejected at admission, engine untouched.
+    before = server.frontdoor.admitted_batches
+    status, resp = client.post(
+        "/submit", {"winners": [PLAYERS + 5], "losers": [0]}
+    )
+    assert status == 400 and "player ids" in resp["error"]
+    assert server.frontdoor.admitted_batches == before
+    # The server still works.
+    status, _resp = client.get("/healthz")
+    assert status == 200
+
+
+def test_wire_counters_flow_into_stats_through_one_registry(wire):
+    """Satellite: `ArenaServer.stats()` reports the wire tier through
+    the SAME registry the handlers write and /stats renders — requests
+    by endpoint and by status, sheds by policy. One schema, no second
+    registry."""
+    server, client = wire
+    before = server.server.stats()["net"]
+    for _ in range(3):
+        client.get("/healthz")
+    client.get("/definitely-404")
+    after = server.server.stats()["net"]
+    assert after["requests"] >= before["requests"] + 4
+    assert (
+        after["requests_by_endpoint"]["healthz"]
+        >= before["requests_by_endpoint"].get("healthz", 0) + 3
+    )
+    assert (
+        after["requests_by_status"]["404"]
+        >= before["requests_by_status"].get("404", 0) + 1
+    )
+    assert isinstance(after["shed_batches_by_policy"], dict)
+    # The same numbers are visible in the Prometheus exposition.
+    _status, text, _headers = client.get_with_headers("/stats")
+    assert 'arena_http_requests_total{endpoint="healthz",status="200"}' in text
+
+
+def test_read_only_replica_answers_503_on_submit():
+    obs = Observability()
+    srv = ArenaServer(num_players=8, obs=obs)
+    srv.engine.ingest(
+        np.array([0, 1], np.int32), np.array([2, 3], np.int32)
+    )
+    with ArenaHTTPServer(srv, frontdoor=None) as server:
+        client = WireClient(server.host, server.port)
+        status, resp = client.submit([0], [1])
+        assert status == 503
+        assert "front door" in resp["error"]
+        assert "watermark" in resp  # even a 503 keeps the envelope
+        status, _resp = client.get("/leaderboard?offset=0&limit=3")
+        assert status == 200  # reads still serve
+        client.close()
+    srv.close()
+
+
+# --- protocol pure functions (no server needed) -----------------------------
+
+
+def test_parse_path_routes_and_statuses():
+    assert parse_path("GET", "/leaderboard?offset=5&limit=2") == (
+        "leaderboard", {"offset": 5, "limit": 2},
+    )
+    assert parse_path("GET", "/leaderboard") == (
+        "leaderboard", {"offset": 0, "limit": 50},
+    )
+    assert parse_path("GET", "/player/12") == ("player", {"player": 12})
+    assert parse_path("GET", "/h2h?a=1&b=2") == ("h2h", {"a": 1, "b": 2})
+    assert parse_path("POST", "/submit") == ("submit", {})
+    assert parse_path("GET", "/stats") == ("stats", {})
+    assert parse_path("GET", "/healthz") == ("healthz", {})
+    for method, path, status in [
+        ("GET", "/", 404),
+        ("GET", "/player", 404),
+        ("GET", "/player/1/extra", 404),
+        ("POST", "/leaderboard", 405),
+        ("GET", "/h2h?a=1&b=x", 400),
+    ]:
+        with pytest.raises(ProtocolError) as exc:
+            parse_path(method, path)
+        assert exc.value.status == status, (method, path)
+
+
+def test_parse_submit_body_validates_shape():
+    w, l, producer = parse_submit_body(
+        b'{"winners": [1, 2], "losers": [3, 4], "producer": "p1"}'
+    )
+    assert w.dtype == np.int32 and list(w) == [1, 2] and list(l) == [3, 4]
+    assert producer == "p1"
+    _w, _l, producer = parse_submit_body(b'{"winners": [], "losers": []}')
+    assert producer == "local"
+    for raw in [
+        b"not json",
+        b"[1, 2]",
+        b'{"winners": [1.5], "losers": [2]}',
+        b'{"winners": [true], "losers": [false]}',
+        b'{"winners": [1], "losers": "x"}',
+        b'{"winners": [1], "losers": [2], "producer": 7}',
+    ]:
+        with pytest.raises(ProtocolError) as exc:
+            parse_submit_body(raw)
+        assert exc.value.status == 400
+
+
+def test_make_response_is_the_authoritative_envelope():
+    """The envelope replaces any payload-supplied watermark/trace pair
+    with the authoritative one — no endpoint can drift."""
+    out = make_response(
+        {"x": 1, "watermark": 999, "trace_id": 999},
+        watermark=42, trace_id=7,
+    )
+    assert out == {"x": 1, "watermark": 42, "trace_id": 7}
