@@ -4,9 +4,17 @@ Two halves, deliberately decoupled:
 
 - `arena.analysis.jaxlint` — AST-based lint rules (stdlib only, never
   imports jax) enforcing the engine's performance invariants at source
-  level. CLI: `python -m arena.analysis [paths...]`; rc 0 = clean,
-  rc 1 = findings, rc 2 = bad path. Findings are suppressible inline
-  with `# jaxlint: disable=<rule>`.
+  level. Since v2 it is a TWO-PASS engine: `arena.analysis.project`
+  builds a project-wide symbol table (modules, classes, functions,
+  meshes, locks, `guarded_by` contracts, imports resolved), then the
+  rules — including the concurrency lock-discipline analyzer in
+  `arena.analysis.concurrency` — run with it in scope, so
+  cross-module facts (a mesh imported from another file, opposite
+  lock-nesting orders in different modules) are lintable. CLI:
+  `python -m arena.analysis [--format=human|json] [paths...]`;
+  rc 0 = clean, rc 1 = findings, rc 2 = bad path. Findings are
+  suppressible inline with `# jaxlint: disable=<rule>` (honored across
+  the enclosing statement for multi-line expressions).
 - `arena.analysis.sanitize` — opt-in RUNTIME checks (imports jax, and
   deliberately NOT re-exported here): `checked()` wires
   jax_debug_nans/jax_debug_infs, `RecompileSentinel` pins

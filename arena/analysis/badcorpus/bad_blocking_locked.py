@@ -1,0 +1,34 @@
+"""jaxlint corpus: blocking calls made while holding a lock.
+
+Every other thread that needs `_lock` stalls for the full queue wait /
+sleep — and `stop()` joins the worker WHILE holding the lock the
+worker needs to finish, the classic self-deadlock. Rule:
+blocking-while-locked."""
+
+import queue
+import threading
+import time
+
+
+class LockedConsumer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self):
+        item = self._q.get(block=True)
+        while item is not None:
+            item = self._q.get(block=True)
+
+    def consume_next(self):
+        with self._lock:
+            item = self._q.get(block=True)  # queue wait under the lock
+            time.sleep(0.01)  # and a sleep on top
+            return item
+
+    def stop(self):
+        with self._lock:
+            self._q.put(None, block=True)
+            self._thread.join()  # the worker may need _lock: deadlock

@@ -1,0 +1,28 @@
+"""jaxlint corpus: a wait loop that trusts the worker to still be alive.
+
+`flush()` waits for the packer thread to set `_done` — but if the
+worker died with an exception, nothing ever notifies and the loop
+spins on the condition FOREVER instead of raising. Every blocking wait
+on worker progress must re-check `.is_alive()` each wakeup (the
+`_check_packer_locked` shape arena/pipeline.py uses). Rule:
+thread-no-liveness-recheck."""
+
+import threading
+
+
+class OneShotPacker:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._done = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._cv:
+            self._done = True
+            self._cv.notify_all()
+
+    def flush(self):
+        with self._cv:
+            while not self._done:
+                self._cv.wait(0.05)  # a dead worker hangs this forever

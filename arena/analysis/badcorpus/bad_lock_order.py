@@ -1,0 +1,30 @@
+"""jaxlint corpus: inconsistent lock nesting order.
+
+`credit()` takes accounts-then-audit, `debit()` takes
+audit-then-accounts: run concurrently, each can hold its first lock
+while waiting forever for the other's. The lock-order graph (which
+spans MODULES in a real project walk — both orders here happen to sit
+in one file) makes the cycle a lint finding instead of a 3am incident.
+Rule: lock-order-inversion."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._accounts = threading.Lock()
+        self._audit = threading.Lock()
+        self.balance = 0
+        self.entries = 0
+
+    def credit(self, n):
+        with self._accounts:
+            with self._audit:
+                self.balance += n
+                self.entries += 1
+
+    def debit(self, n):
+        with self._audit:
+            with self._accounts:
+                self.balance -= n
+                self.entries += 1

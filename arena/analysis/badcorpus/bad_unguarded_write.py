@@ -1,0 +1,25 @@
+"""jaxlint corpus: writing declared-guarded state without the lock.
+
+`applied` carries the `# guarded_by: _lock` contract and the class
+hands itself to a worker thread — but `bump()` mutates the counter
+with no lock held, so the worker's increment and the caller's can
+interleave as a lost update. Rule: unguarded-shared-write."""
+
+import threading
+
+
+class StatsSink:
+    """Shared between the spawning caller and its worker thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.applied = 0  # guarded_by: _lock
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self.applied += 1
+
+    def bump(self, n):
+        self.applied += n  # races the worker: read-modify-write, no lock

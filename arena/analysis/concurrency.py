@@ -1,0 +1,278 @@
+"""Lock-discipline rules: the concurrency half of jaxlint v2.
+
+PRs 4-9 made arena genuinely concurrent — a packer thread, per-metric
+registry locks, an RLock'd `MergeableCSR`, a reorder-buffered front
+door, a threading HTTP server — but until now the only static gate
+knew nothing about threads, so the class of bug MOST likely to ship
+(an unguarded touch of lock-guarded state, a blocking call made while
+holding a lock) was invisible. These four rules run on the pass-1
+symbol table (`arena/analysis/project.py`) the two-pass driver builds:
+
+- `unguarded-shared-write` — the `# guarded_by: <lockname>` annotation
+  on a class attribute is a contract: every assignment to it outside
+  `__init__` must happen while holding `self.<lockname>` (lexically
+  inside `with self.<lockname>:`, or in a `*_locked` method — the
+  repo's called-with-lock-held naming convention). Annotations opt a
+  class in; the four production modules that share state across
+  threads (`ingest.py`, `pipeline.py`, `obs/metrics.py`,
+  `net/frontdoor.py`) carry them, so the clean-tree-lints-clean
+  invariant is a real concurrency contract, not a vacuous pass.
+- `blocking-while-locked` — `time.sleep`, `.join()` (zero positional
+  args, so `str.join(iterable)` never matches), blocking queue
+  `.get/.put(block=True)`, and `block_until_ready` inside a held-lock
+  region: every other thread needing that lock stalls for the full
+  wait, and joining a thread that needs the lock is a deadlock.
+  `Condition.wait()` is deliberately NOT in the set — it releases the
+  lock, which is the sanctioned wait shape.
+- `lock-order-inversion` — two locks acquired in opposite nesting
+  orders anywhere across the PROJECT (the cross-module lock-order
+  graph: lexical nesting plus one-hop call-through edges resolved
+  through the symbol table). Reported once per inverted pair per
+  module, at a site that acquires in one of the two orders.
+- `thread-no-liveness-recheck` — in a class that spawns a worker
+  thread, a wait loop (`while ...: cond.wait(...)`) that never
+  re-checks worker liveness (`.is_alive`, directly or one call deep
+  into same-class helpers): if the worker died, the loop hangs
+  forever — the exact hang class PR 4 fixed by hand with
+  `_check_packer_locked()`. Thread-target methods themselves are
+  exempt (the worker waiting for work needs no liveness check on
+  itself).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from arena.analysis.jaxlint import Finding, rule
+from arena.analysis.project import (
+    LOCKED_SUFFIX,
+    dotted,
+    make_lock_resolver,
+    scan_function,
+    _self_attr_writes,
+    _stmt_exprs,
+)
+
+_SLEEP_CALLS = frozenset({"time.sleep", "sleep"})
+_BLOCKING_QUEUE_METHODS = frozenset({"get", "put"})
+
+
+def _short_lock(lock_id: str) -> str:
+    """Human form of a project-global lock id: Class.attr or name."""
+    return ".".join(lock_id.split(".")[-2:])
+
+
+def _iter_scopes(symbols):
+    """(fn_node, cls, held0) for every function and method: `_locked`
+    methods start with every class lock held (the convention)."""
+    for fn_node in symbols.functions.values():
+        yield fn_node, None, ()
+    for cls in symbols.classes.values():
+        for mname, mnode in cls.methods.items():
+            held0 = ()
+            if mname.endswith(LOCKED_SUFFIX):
+                held0 = tuple(sorted(cls.lock_ids()))
+            yield mnode, cls, held0
+
+
+@rule(
+    "unguarded-shared-write",
+    "assignment to a `# guarded_by: <lock>`-annotated attribute outside a "
+    "`with self.<lock>:` block (or a *_locked method) in a thread-shared "
+    "class — a data race on declared-guarded state",
+)
+def _check_unguarded_shared_write(ctx):
+    for cls in ctx.symbols.classes.values():
+        if not cls.guarded:
+            continue
+        # The annotation is the opt-in: a class declaring guarded state
+        # either spawns threads or is handed to them (why else guard?).
+        if not (cls.spawns_thread or cls.lock_attrs):
+            continue
+        for mname, mnode in cls.methods.items():
+            if mname == "__init__":
+                continue  # pre-publication writes need no lock
+            held_names = set(cls.lock_attrs) if mname.endswith(LOCKED_SUFFIX) else set()
+
+            def resolve_attr(expr, _cls=cls):
+                name = dotted(expr)
+                if name and name.startswith("self."):
+                    attr = name.split(".", 1)[1]
+                    if "." not in attr and attr in _cls.lock_attrs:
+                        return attr
+                return None
+
+            _acq, _edges, stmts = scan_function(
+                mnode, resolve_attr, tuple(sorted(held_names))
+            )
+            for stmt, held in stmts:
+                for attr, tgt in _self_attr_writes(stmt):
+                    guard = cls.guarded.get(attr)
+                    if guard and guard not in held:
+                        yield ctx.finding(
+                            tgt,
+                            "unguarded-shared-write",
+                            f"`self.{attr}` is declared `guarded_by: {guard}` "
+                            f"but `{cls.name}.{mname}` writes it without "
+                            f"holding `self.{guard}` — a racing thread can "
+                            "observe or lose this update",
+                        )
+
+
+def _blocking_reason(call: ast.Call):
+    """Why a call blocks while a lock is held, or None."""
+    fname = dotted(call.func) or ""
+    if fname in _SLEEP_CALLS:
+        return f"`{fname}(...)` sleeps"
+    if fname.split(".")[-1] == "block_until_ready":
+        return f"`{fname}(...)` waits for the device"
+    if isinstance(call.func, ast.Attribute):
+        meth = call.func.attr
+        if meth == "join" and not call.args:
+            return "`.join()` waits for another thread"
+        if meth in _BLOCKING_QUEUE_METHODS:
+            for kw in call.keywords:
+                if (
+                    kw.arg == "block"
+                    and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                ):
+                    return f"`.{meth}(block=True)` blocks on the queue"
+    return None
+
+
+@rule(
+    "blocking-while-locked",
+    "a blocking call (time.sleep / .join() / queue get-put with block=True "
+    "/ block_until_ready) inside a held-lock region — every thread needing "
+    "the lock stalls for the full wait",
+)
+def _check_blocking_while_locked(ctx):
+    symbols = ctx.symbols
+    for fn_node, cls, held0 in _iter_scopes(symbols):
+        resolver = make_lock_resolver(symbols, cls)
+        _acq, _edges, stmts = scan_function(fn_node, resolver, held0)
+        for stmt, held in stmts:
+            if not held:
+                continue
+            for expr in _stmt_exprs(stmt):
+                if not isinstance(expr, ast.Call):
+                    continue
+                reason = _blocking_reason(expr)
+                if reason is not None:
+                    yield ctx.finding(
+                        expr,
+                        "blocking-while-locked",
+                        f"{reason} while `{_short_lock(held[-1])}` is held "
+                        f"in `{fn_node.name}` — release the lock first, or "
+                        "bound the wait outside the critical section",
+                    )
+
+
+@rule(
+    "lock-order-inversion",
+    "two locks are acquired in opposite nesting orders somewhere across "
+    "the project (lexical nesting + one-hop call-through edges from the "
+    "cross-module lock-order graph) — a deadlock waiting for load",
+)
+def _check_lock_order_inversion(ctx):
+    table = ctx.project
+    if table is None:
+        return
+    pairs = {}
+    for outer, inner, mod_name, line, col in table.all_lock_edges():
+        if outer == inner:
+            continue  # RLock re-entry is legal
+        pairs.setdefault((outer, inner), []).append((mod_name, line, col))
+    reported = set()
+    for (a, b) in sorted(pairs):
+        if (b, a) not in pairs:
+            continue
+        key = frozenset((a, b))
+        if key in reported:
+            continue
+        for mod_name, line, col in sorted(pairs[(a, b)]):
+            if mod_name != ctx.symbols.name:
+                continue
+            reported.add(key)
+            other = sorted(pairs[(b, a)])[0]
+            yield Finding(
+                ctx.path,
+                line,
+                col,
+                "lock-order-inversion",
+                f"`{_short_lock(b)}` is acquired while holding "
+                f"`{_short_lock(a)}` here, but `{other[0]}` (line "
+                f"{other[1]}) nests them the other way around — "
+                "inconsistent lock order deadlocks under contention",
+            )
+            break
+
+
+def _walk_confined(node):
+    """ast.walk that does not descend into nested function/class defs."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if not isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                stack.append(child)
+
+
+def _rechecks_liveness(while_node, cls):
+    """True if the loop re-checks worker liveness: an `.is_alive`
+    reference in the loop, or one call deep into a same-class helper
+    whose body references it (`_check_packer_locked` shape)."""
+    for node in _walk_confined(while_node):
+        if isinstance(node, ast.Attribute) and node.attr == "is_alive":
+            return True
+    for node in _walk_confined(while_node):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func)
+        if not fname or not fname.startswith("self."):
+            continue
+        helper = cls.methods.get(fname.split(".", 1)[1])
+        if helper is None:
+            continue
+        for sub in ast.walk(helper):
+            if isinstance(sub, ast.Attribute) and sub.attr == "is_alive":
+                return True
+    return False
+
+
+@rule(
+    "thread-no-liveness-recheck",
+    "a blocking wait loop in a thread-spawning class never re-checks "
+    "worker liveness (.is_alive) — if the worker died, the caller hangs "
+    "forever instead of raising",
+)
+def _check_thread_no_liveness_recheck(ctx):
+    for cls in ctx.symbols.classes.values():
+        if not cls.spawns_thread:
+            continue
+        for mname, mnode in cls.methods.items():
+            if mname == "__init__" or mname in cls.thread_targets:
+                continue  # the worker itself waits for work, not for itself
+            for node in _walk_confined(mnode):
+                if not isinstance(node, ast.While):
+                    continue
+                waits = any(
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "wait"
+                    for sub in _walk_confined(node)
+                )
+                if waits and not _rechecks_liveness(node, cls):
+                    yield ctx.finding(
+                        node,
+                        "thread-no-liveness-recheck",
+                        f"`{cls.name}.{mname}` waits in a loop for progress "
+                        "a worker thread must make, but never re-checks "
+                        "worker liveness — a dead worker hangs this caller "
+                        "forever (re-check `.is_alive()` each wakeup and "
+                        "raise instead)",
+                    )

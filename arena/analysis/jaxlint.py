@@ -1,4 +1,4 @@
-"""jaxlint — JAX-aware static analysis guarding the arena hot path.
+"""jaxlint v2 — cross-module static analysis guarding the arena hot path.
 
 PR 1's measured speedup rests on invariants no runtime check enforces
 by default: zero recompiles across variable batch sizes (the pow2
@@ -8,6 +8,18 @@ and NumPy — not jnp — on host-side ingest paths. Each rule here is one
 of those invariants expressed over the stdlib `ast`, so a regression
 is caught at lint time instead of as a silently-lost speedup in a
 bench run weeks later.
+
+v2 adds the TWO-PASS driver: `lint_paths` first builds a project-wide
+symbol table over every file being linted (`arena/analysis/project.py`
+— module -> classes/functions/meshes/locks/assigned attributes, with
+`from x import y` and attribute chains resolved), then runs the rules
+with that table in scope (`ModuleContext.project`). That closes the
+gap ROADMAP item 3 names (`sharding-spec-arity` now resolves meshes
+DEFINED IN OTHER MODULES) and carries the concurrency lock-discipline
+analyzer (`arena/analysis/concurrency.py`): `unguarded-shared-write`,
+`blocking-while-locked`, `lock-order-inversion`,
+`thread-no-liveness-recheck`, built on the `# guarded_by: <lockname>`
+annotation convention the production modules now use.
 
 Design:
 
@@ -21,8 +33,9 @@ Design:
   with a kebab-case name and a one-line summary; `RULES` is the
   registry the CLI, the tests, and the bad-example corpus all iterate.
   A rule receives a `ModuleContext` (one shared analysis pass: jitted
-  callables + their static/donate info, traced function bodies,
-  suppression table) and yields `Finding`s.
+  callables + their static/donate info, traced function bodies, the
+  module's symbols, the project table, suppression table) and yields
+  `Finding`s.
 - **Heuristic, not sound.** This is a linter: dotted-name matching and
   straight-line dataflow, not type inference. Rules are tuned so the
   CLEAN TREE LINTS CLEAN (a tier-1 test pins zero findings over
@@ -30,8 +43,17 @@ Design:
   corpus (`arena/analysis/badcorpus/`, excluded from default walks).
 - **Suppressible.** `# jaxlint: disable=<rule>[,<rule>...]` on the
   offending line suppresses named rules there; `disable=all` mutes the
-  line. Deliberate violations (e.g. the sanitizer tests proving
-  reuse-after-donate fails loudly) carry the comment as documentation.
+  line. The directive is honored across the whole ENCLOSING STATEMENT
+  for multi-line expressions (a decorated def, a wrapped `with`
+  header), so the comment can sit on any line of the statement the
+  finding points into. Deliberate violations (e.g. the sanitizer tests
+  proving reuse-after-donate fails loudly) carry the comment as
+  documentation.
+- **Machine-readable output.** `--format=json` emits one JSON object
+  per line (rule/path/line/col/message/suppressed — suppressed
+  findings included, flagged) with rc semantics unchanged, so CI and
+  the perf watchdog consume lint output mechanically; the human
+  format stays the default.
 
 What "jitted" means to the linter (tracked per module):
 
@@ -53,9 +75,13 @@ import argparse
 import ast
 import dataclasses
 import io
+import json
 import pathlib
 import sys
 import tokenize
+
+from arena.analysis import project as project_mod
+from arena.analysis.project import dotted
 
 # --- findings and the rule registry ---------------------------------------
 
@@ -67,6 +93,7 @@ class Finding:
     col: int
     rule: str
     message: str
+    suppressed: bool = False
 
     def format(self) -> str:
         return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
@@ -93,18 +120,8 @@ def rule(name, summary):
 
 
 # --- shared AST helpers ----------------------------------------------------
-
-
-def dotted(node) -> str | None:
-    """'a.b.c' for Name/Attribute chains, else None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+# (`dotted` lives in arena.analysis.project — the symbol table and the
+# rules share one spelling of name resolution.)
 
 
 def scope_walk(scope):
@@ -200,13 +217,22 @@ def _decorator_is_tracing(dec) -> bool:
 
 
 class ModuleContext:
-    """One parse + one discovery pass, shared by every rule."""
+    """One parse + one discovery pass, shared by every rule.
+
+    `symbols` is this module's slice of the pass-1 symbol table;
+    `project` is the whole `ProjectTable` (set by the two-pass driver —
+    `lint_source` wraps a single-module table so rules never branch on
+    its absence beyond cross-module lookups failing softly).
+    """
 
     def __init__(self, path: str, source: str):
         self.path = path
         self.source = source
         self.tree = ast.parse(source, filename=path)
-        self.suppressions = _suppression_table(source)
+        raw_suppressions, comments = _comment_tables(source)
+        self.suppressions = _expand_suppressions(self.tree, raw_suppressions)
+        self.symbols = project_mod.module_symbols(path, self.tree, comments)
+        self.project = None
         # dotted target name -> JitInfo, collected from every assignment
         # anywhere in the module (covers `self._update = jax.jit(...)`
         # in __init__ being called from another method).
@@ -259,15 +285,20 @@ class ModuleContext:
         return Finding(self.path, node.lineno, node.col_offset, rule_name, message)
 
 
-def _suppression_table(source: str) -> dict[int, set[str]]:
-    """lineno -> set of rule names disabled there ({'all'} mutes the line)."""
+def _comment_tables(source: str):
+    """Two line-keyed comment tables from ONE tokenize pass:
+    suppression directives (lineno -> rule names disabled; {'all'}
+    mutes) and raw comment text (lineno -> text — the symbol table
+    reads `guarded_by:` annotations from it)."""
     table: dict[int, set[str]] = {}
+    comments: dict[int, str] = {}
     try:
         tokens = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in tokens:
             if tok.type != tokenize.COMMENT:
                 continue
             text = tok.string.lstrip("#").strip()
+            comments[tok.start[0]] = text
             if not text.startswith("jaxlint:"):
                 continue
             directive = text[len("jaxlint:"):].strip()
@@ -276,7 +307,51 @@ def _suppression_table(source: str) -> dict[int, set[str]]:
                 table.setdefault(tok.start[0], set()).update(n for n in names if n)
     except tokenize.TokenError:
         pass  # unterminated source: lint what parsed, suppress nothing
-    return table
+    return table, comments
+
+
+def _stmt_header_span(stmt) -> tuple[int, int]:
+    """The line span a suppression directive on any of its lines covers:
+    for compound statements, first decorator line through the header's
+    last line (the body is NOT included — a comment inside a with/if
+    body must not mute findings on the header, and vice versa); for
+    simple statements, the whole (possibly wrapped) expression."""
+    start = stmt.lineno
+    if isinstance(
+        stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+    ) and stmt.decorator_list:
+        start = min(start, min(d.lineno for d in stmt.decorator_list))
+    body = getattr(stmt, "body", None)
+    if isinstance(body, list) and body and isinstance(body[0], ast.stmt):
+        end = body[0].lineno - 1
+    else:
+        end = stmt.end_lineno or stmt.lineno
+    return start, end
+
+
+def _expand_suppressions(tree, table: dict[int, set[str]]) -> dict[int, set[str]]:
+    """Widen line-keyed directives to their enclosing statement: a
+    finding inside a multi-line expression (a decorated def, a wrapped
+    `with` header, a parenthesized assignment) is suppressed by a
+    directive on ANY line of that statement's header span — the
+    comment naturally sits at the end of the wrapped construct, while
+    the finding points at the line the offending node started on."""
+    if not table:
+        return table
+    out = {line: set(rules) for line, rules in table.items()}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        start, end = _stmt_header_span(node)
+        if end <= start:
+            continue
+        merged: set[str] = set()
+        for line in range(start, end + 1):
+            merged |= table.get(line, set())
+        if merged:
+            for line in range(start, end + 1):
+                out.setdefault(line, set()).update(merged)
+    return out
 
 
 # --- rules ----------------------------------------------------------------
@@ -637,19 +712,6 @@ def _check_jnp_on_host_path(ctx: ModuleContext):
                 )
 
 
-def _module_str_constants(tree) -> dict:
-    """Module-level `NAME = "literal"` bindings — how mesh axis names
-    are spelled in this repo (e.g. `DATA_AXIS = "data"`)."""
-    out = {}
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
-            if isinstance(node.value.value, str):
-                for tgt in node.targets:
-                    if isinstance(tgt, ast.Name):
-                        out[tgt.id] = node.value.value
-    return out
-
-
 def _pspec_aliases(tree) -> set:
     """Names PartitionSpec is bound to ('PartitionSpec' plus any
     `from jax.sharding import PartitionSpec as P` alias)."""
@@ -660,40 +722,6 @@ def _pspec_aliases(tree) -> set:
                 if alias.name == "PartitionSpec":
                     names.add(alias.asname or alias.name)
     return names
-
-
-def _collect_mesh_axes(tree, str_consts):
-    """(axis-name set, known) over every `Mesh(...)` call in the module.
-
-    Axis names come from the second positional argument or the
-    `axis_names=` keyword; string constants and module-level string
-    bindings resolve, anything else makes the set unknown (known=False)
-    so the axis-name check stays quiet rather than guessing.
-    """
-    axes = set()
-    found = False
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fname = dotted(node.func)
-        if fname is None or fname.split(".")[-1] != "Mesh":
-            continue
-        found = True
-        spec = node.args[1] if len(node.args) >= 2 else None
-        for kw in node.keywords:
-            if kw.arg == "axis_names":
-                spec = kw.value
-        if spec is None:
-            return set(), False
-        elts = spec.elts if isinstance(spec, (ast.Tuple, ast.List)) else [spec]
-        for e in elts:
-            if isinstance(e, ast.Constant) and isinstance(e.value, str):
-                axes.add(e.value)
-            elif isinstance(e, ast.Name) and e.id in str_consts:
-                axes.add(str_consts[e.id])
-            else:
-                return set(), False
-    return axes, found
 
 
 def _shard_map_site(call):
@@ -717,13 +745,15 @@ def _shard_map_site(call):
 @rule(
     "sharding-spec-arity",
     "shard_map in_specs arity disagrees with the wrapped function, or a "
-    "PartitionSpec names a mesh axis no mesh in the module defines — the "
-    "silent class of mistake match_partition_rules only catches at runtime",
+    "PartitionSpec names a mesh axis the site's mesh does not define — "
+    "resolved CROSS-MODULE through the project symbol table, the silent "
+    "class of mistake match_partition_rules only catches at runtime",
 )
 def _check_sharding_spec_arity(ctx: ModuleContext):
     tree = ctx.tree
-    str_consts = _module_str_constants(tree)
-    axes, axes_known = _collect_mesh_axes(tree, str_consts)
+    sym = ctx.symbols
+    str_consts = sym.str_consts
+    local_axes, local_known = sym.mesh_union
     pspec_names = _pspec_aliases(tree)
     defs_by_name = {
         n.name: n
@@ -737,6 +767,27 @@ def _check_sharding_spec_arity(ctx: ModuleContext):
         if isinstance(arg, ast.Name) and arg.id in str_consts:
             return str_consts[arg.id]
         return None  # None / unresolvable: no claim
+
+    def site_mesh(kws):
+        """(axes, known, where) for THIS site's mesh: the `mesh=` kwarg
+        resolved by name — locally, then through the project table
+        (the v2 cross-module upgrade: a mesh imported from another
+        module resolves to its defining module's axis names). Falls
+        back to the module union (v1 semantics) when the site's mesh
+        expression is not a resolvable name."""
+        mesh_expr = kws.get("mesh")
+        if mesh_expr is not None:
+            name = dotted(mesh_expr)
+            if name:
+                if name in sym.meshes:
+                    axes, known = sym.meshes[name]
+                    return axes, known, "this module"
+                if ctx.project is not None:
+                    resolved = ctx.project.resolve_mesh(sym, name)
+                    if resolved is not None:
+                        axes, known = resolved
+                        return axes, known, f"`{name}`'s defining module"
+        return local_axes, local_known, "this module"
 
     def check_site(kws, fn_def):
         in_specs = kws.get("in_specs")
@@ -756,6 +807,7 @@ def _check_sharding_spec_arity(ctx: ModuleContext):
                     f"`{fn_def.name}` takes {nparams} arguments — every "
                     "operand needs exactly one PartitionSpec",
                 )
+        axes, axes_known, where = site_mesh(kws)
         for spec_expr in (in_specs, kws.get("out_specs")):
             if spec_expr is None or not axes_known:
                 continue
@@ -772,7 +824,7 @@ def _check_sharding_spec_arity(ctx: ModuleContext):
                             node,
                             "sharding-spec-arity",
                             f"PartitionSpec axis {name!r} is not defined by "
-                            "any mesh in this module (mesh axes: "
+                            f"the mesh at this site ({where} defines axes "
                             f"{sorted(axes)}) — sharding over it fails at "
                             "runtime or silently replicates",
                         )
@@ -802,12 +854,11 @@ def _check_sharding_spec_arity(ctx: ModuleContext):
 BADCORPUS_DIR = "badcorpus"
 
 
-def lint_source(source: str, path: str = "<string>") -> list[Finding]:
-    """Lint one module's source; returns findings after suppression."""
-    try:
-        ctx = ModuleContext(path, source)
-    except SyntaxError as exc:
-        return [Finding(path, exc.lineno or 0, exc.offset or 0, "syntax-error", str(exc))]
+def _apply_rules(ctx: ModuleContext, keep_suppressed: bool) -> list[Finding]:
+    """Pass 2 for one module: run every rule, then apply the
+    suppression table. keep_suppressed=True returns muted findings too,
+    marked `suppressed=True` (the JSON format's contract); they never
+    affect exit codes."""
     findings = []
     for r in RULES.values():
         findings.extend(r.check(ctx))
@@ -815,9 +866,30 @@ def lint_source(source: str, path: str = "<string>") -> list[Finding]:
     for f in findings:
         disabled = ctx.suppressions.get(f.line, set())
         if "all" in disabled or f.rule in disabled:
+            if keep_suppressed:
+                kept.append(dataclasses.replace(f, suppressed=True))
             continue
         kept.append(f)
-    return sorted(kept, key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept
+
+
+def _sorted_findings(findings):
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def lint_source(
+    source: str, path: str = "<string>", keep_suppressed: bool = False
+) -> list[Finding]:
+    """Lint one module's source; returns findings after suppression.
+    Single-module form: the project table holds just this module, so
+    cross-module lookups fail softly (imported meshes stay unknown —
+    exactly the v1 behavior `lint_paths` upgrades on)."""
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, exc.offset or 0, "syntax-error", str(exc))]
+    ctx.project = project_mod.ProjectTable([ctx.symbols])
+    return _sorted_findings(_apply_rules(ctx, keep_suppressed))
 
 
 def iter_python_files(paths):
@@ -842,17 +914,46 @@ def iter_python_files(paths):
             raise FileNotFoundError(f"no such file or directory: {raw}")
 
 
-def lint_paths(paths) -> list[Finding]:
+def lint_paths(paths, keep_suppressed: bool = False) -> list[Finding]:
+    """The two-pass driver: pass 1 parses EVERY file and builds the
+    project-wide symbol table; pass 2 runs the rules per module with
+    that table in scope — so a rule looking at module B can resolve a
+    mesh or a lock defined in module A."""
     findings = []
+    contexts = []
     for f in iter_python_files(paths):
-        findings.extend(lint_source(f.read_text(), str(f)))
-    return findings
+        try:
+            contexts.append(ModuleContext(str(f), f.read_text()))
+        except SyntaxError as exc:
+            findings.append(
+                Finding(str(f), exc.lineno or 0, exc.offset or 0,
+                        "syntax-error", str(exc))
+            )
+    table = project_mod.ProjectTable([ctx.symbols for ctx in contexts])
+    for ctx in contexts:
+        ctx.project = table
+        findings.extend(_apply_rules(ctx, keep_suppressed))
+    return _sorted_findings(findings)
 
 
 def default_targets() -> list[str]:
     """The repo surfaces the tier-1 gate lints: arena/, bench.py, tests/."""
     repo = pathlib.Path(__file__).resolve().parent.parent.parent
     return [str(repo / "arena"), str(repo / "bench.py"), str(repo / "tests")]
+
+
+def _json_line(finding: Finding) -> str:
+    """One finding as one JSON object on one line — the mechanical
+    consumption contract (CI, the perf watchdog): stable keys, no
+    nesting, suppressed findings included but flagged."""
+    return json.dumps({
+        "rule": finding.rule,
+        "path": finding.path,
+        "line": finding.line,
+        "col": finding.col,
+        "message": finding.message,
+        "suppressed": finding.suppressed,
+    }, sort_keys=True)
 
 
 def main(argv=None) -> int:
@@ -868,6 +969,12 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule registry and exit"
     )
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human",
+        help="human (default): path:line:col: rule: message; json: one "
+        "JSON object per finding per line (suppressed findings included, "
+        "flagged). Exit codes are identical in both formats.",
+    )
     args = parser.parse_args(argv)
     if args.list_rules:
         for r in RULES.values():
@@ -875,17 +982,29 @@ def main(argv=None) -> int:
         return 0
     targets = args.paths or default_targets()
     try:
-        findings = lint_paths(targets)
+        findings = lint_paths(targets, keep_suppressed=(args.format == "json"))
     except FileNotFoundError as exc:
         print(f"jaxlint: {exc}", file=sys.stderr)
         return 2
-    for f in findings:
-        print(f.format())
+    live = [f for f in findings if not f.suppressed]
+    if args.format == "json":
+        for f in findings:
+            print(_json_line(f))
+    else:
+        for f in live:
+            print(f.format())
     print(
-        f"jaxlint: {len(findings)} finding(s) over {len(RULES)} rule(s)",
+        f"jaxlint: {len(live)} finding(s) over {len(RULES)} rule(s)",
         file=sys.stderr,
     )
-    return 1 if findings else 0
+    return 1 if live else 0
+
+
+# Register the concurrency lock-discipline rules (they import this
+# module's registry, so the import sits at the bottom — by now every
+# name they need is defined; either import order ends with all rules
+# registered exactly once).
+from arena.analysis import concurrency as _concurrency  # noqa: E402,F401
 
 
 if __name__ == "__main__":

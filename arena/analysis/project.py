@@ -1,0 +1,553 @@
+"""Cross-module symbol table: pass 1 of the jaxlint v2 two-pass driver.
+
+jaxlint v1 analyzed one file at a time, so anything defined elsewhere —
+a mesh imported from another module, a lock shared across classes — was
+invisible, and the rules either stayed quiet (sharding-spec-arity on an
+imported mesh) or could not exist at all (lock-order inversion is a
+property of the PROJECT, not a file). This module is the fix: one pass
+over every file being linted builds a `ProjectTable` mapping
+
+    module -> classes / functions / meshes / locks / assigned attributes
+
+with `from x import y` and `import x.y as z` attribute chains resolved
+against the table, and pass 2 (the rules in `jaxlint.py` and
+`concurrency.py`) runs with that table in scope via
+`ModuleContext.project`.
+
+Conventions the table understands (all stdlib `ast` + `tokenize`, no
+imports executed, no jax anywhere):
+
+- **Module names** are derived from the filesystem: walk up from the
+  file while `__init__.py` is present, so `arena/ingest.py` is
+  `arena.ingest` whether the lint target was `arena/` or the repo root.
+  Import resolution is suffix-tolerant (`ProjectTable.module`) so a
+  fixture rooted elsewhere still resolves.
+- **Meshes**: `name = Mesh(..., (AXES,))` assignments, axis names
+  resolved through string constants exactly as the v1 rule did — but
+  now recorded per NAME so `from meshes import mesh` in another module
+  resolves to the defining module's axis set.
+- **Locks**: `self._x = threading.Lock()/RLock()/Condition()` class
+  attributes and module-level `NAME = threading.Lock()` globals. Lock
+  IDENTITY is the dotted `module.Class.attr` (or `module.NAME`) string,
+  so the same lock acquired from two modules unifies in the project's
+  lock-order graph.
+- **`# guarded_by: <lockname>`** comments on `self.attr = ...`
+  assignment lines declare the concurrency contract the
+  `unguarded-shared-write` rule enforces: every later write to that
+  attribute must happen while holding `self.<lockname>` (lexically
+  inside `with self.<lockname>:`, or in a method whose name ends in
+  `_locked` — the repo's called-with-lock-held convention).
+- **Lock-order edges**: for every `with` acquiring lock B lexically
+  inside a held lock A, the edge (A, B) is recorded; calls made while
+  holding a lock are recorded too and resolved one level deep through
+  the table (same-class methods, module functions, `from x import f`)
+  so a with-block that calls into another module's locking code still
+  contributes edges. Inconsistent orderings across the whole table are
+  the `lock-order-inversion` rule's findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+
+# The annotation convention: `self.attr = ...  # guarded_by: _lock`.
+GUARDED_BY_RE = re.compile(r"guarded_by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+# threading constructors whose assignment makes an attribute "a lock"
+# (a Condition wraps a lock; acquiring it IS acquiring the lock).
+LOCK_FACTORY_TAILS = frozenset({"Lock", "RLock", "Condition", "Semaphore"})
+
+# Methods whose names end with this suffix are the repo's
+# called-with-the-lock-held convention (`_add_locked`, `_shed_locked`):
+# their bodies are treated as held regions for every class lock.
+LOCKED_SUFFIX = "_locked"
+
+
+def dotted(node) -> str | None:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name from the filesystem: walk up while the parent
+    holds an `__init__.py`, so the name matches how the repo's own
+    imports spell it regardless of which lint root reached the file."""
+    p = pathlib.Path(path)
+    if p.suffix != ".py":
+        return p.name or "module"
+    parts = [] if p.stem == "__init__" else [p.stem]
+    parent = p.parent
+    while parent.name and (parent / "__init__.py").exists():
+        parts.insert(0, parent.name)
+        parent = parent.parent
+    return ".".join(parts) or p.stem
+
+
+@dataclasses.dataclass
+class ClassSymbols:
+    """One class's concurrency-relevant surface."""
+
+    name: str
+    module: str
+    node: object  # the ast.ClassDef
+    lock_attrs: set = dataclasses.field(default_factory=set)
+    guarded: dict = dataclasses.field(default_factory=dict)  # attr -> lock
+    assigned_attrs: set = dataclasses.field(default_factory=set)
+    spawns_thread: bool = False
+    thread_targets: set = dataclasses.field(default_factory=set)
+    methods: dict = dataclasses.field(default_factory=dict)  # name -> node
+
+    def lock_ids(self):
+        return {f"{self.module}.{self.name}.{a}" for a in sorted(self.lock_attrs)}
+
+
+@dataclasses.dataclass
+class ModuleSymbols:
+    """Everything pass 2 needs to know about one module."""
+
+    name: str
+    path: str
+    str_consts: dict = dataclasses.field(default_factory=dict)
+    meshes: dict = dataclasses.field(default_factory=dict)  # var -> (axes, known)
+    mesh_union: tuple = (frozenset(), False)  # (axes, known) over every Mesh call
+    has_mesh: bool = False
+    imports: dict = dataclasses.field(default_factory=dict)  # name -> (module, symbol|None)
+    module_locks: set = dataclasses.field(default_factory=set)
+    classes: dict = dataclasses.field(default_factory=dict)  # name -> ClassSymbols
+    functions: dict = dataclasses.field(default_factory=dict)  # name -> node
+    func_locks: dict = dataclasses.field(default_factory=dict)  # qualname -> set[id]
+    lock_edges: list = dataclasses.field(default_factory=list)  # (outer, inner, line, col)
+    lock_calls: list = dataclasses.field(default_factory=list)  # (held, callee, line, col)
+
+
+# --- collection helpers ----------------------------------------------------
+
+
+def _module_str_constants(tree) -> dict:
+    """Module-level `NAME = "literal"` bindings — how mesh axis names
+    are spelled in this repo (e.g. `DATA_AXIS = "data"`)."""
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = node.value.value
+    return out
+
+
+def _mesh_axes_from_call(call: ast.Call, str_consts) -> tuple:
+    """(axis-name set, known) for one `Mesh(...)` call. Axis names come
+    from the second positional argument or `axis_names=`; string
+    constants and module-level string bindings resolve, anything else
+    makes the set unknown (known=False) so the axis-name check stays
+    quiet rather than guessing."""
+    spec = call.args[1] if len(call.args) >= 2 else None
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            spec = kw.value
+    if spec is None:
+        return frozenset(), False
+    axes = set()
+    elts = spec.elts if isinstance(spec, (ast.Tuple, ast.List)) else [spec]
+    for e in elts:
+        if isinstance(e, ast.Constant) and isinstance(e.value, str):
+            axes.add(e.value)
+        elif isinstance(e, ast.Name) and e.id in str_consts:
+            axes.add(str_consts[e.id])
+        else:
+            return frozenset(), False
+    return frozenset(axes), True
+
+
+def _collect_meshes(tree, str_consts):
+    """(per-name meshes, (union axes, union known), has_mesh) over every
+    `Mesh(...)` call — named assignments feed cross-module resolution,
+    the union preserves the v1 whole-module fallback semantics."""
+    meshes = {}
+    union: set = set()
+    union_known = True
+    has_mesh = False
+
+    def is_mesh_call(node):
+        if not isinstance(node, ast.Call):
+            return False
+        fname = dotted(node.func)
+        return fname is not None and fname.split(".")[-1] == "Mesh"
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and is_mesh_call(node.value):
+            axes, known = _mesh_axes_from_call(node.value, str_consts)
+            for tgt in node.targets:
+                name = dotted(tgt)
+                if name:
+                    meshes[name] = (axes, known)
+        if is_mesh_call(node):
+            has_mesh = True
+            axes, known = _mesh_axes_from_call(node, str_consts)
+            if known:
+                union |= set(axes)
+            else:
+                union_known = False
+    if not has_mesh:
+        return meshes, (frozenset(), False), False
+    return meshes, (frozenset(union), union_known), True
+
+
+def _collect_imports(tree, mod_name: str) -> dict:
+    """local binding -> (source module, symbol|None). `import x.y as z`
+    binds z to the module; `from x import y` binds y to x's symbol y
+    (which may itself be a submodule — resolution tries both)."""
+    imports = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                imports[alias.asname or alias.name] = (alias.name, None)
+        elif isinstance(node, ast.ImportFrom):
+            module = node.module or ""
+            if node.level:
+                base = mod_name.split(".")
+                base = base[: len(base) - node.level]
+                module = ".".join(base + ([module] if module else []))
+            for alias in node.names:
+                imports[alias.asname or alias.name] = (module, alias.name)
+    return imports
+
+
+def _self_attr_writes(stmt):
+    """(attr, node) for every `self.X = / self.X[...] = / self.X += ...`
+    store in one statement — tuple targets unpacked, subscript chains
+    peeled back to the attribute they mutate."""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    out = []
+    while targets:
+        tgt = targets.pop()
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            targets.extend(tgt.elts)
+            continue
+        node = tgt
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            if node.value.id == "self":
+                out.append((node.attr, tgt))
+    return out
+
+
+_COMPOUND_BODY_FIELDS = ("body", "orelse", "finalbody")
+
+
+def scan_function(fn_node, resolve_item, held0=()):
+    """Walk one function's statements tracking the held-lock stack.
+
+    `resolve_item(expr)` maps a with-item expression to a lock token
+    (any hashable) or None; `held0` seeds the stack (the `_locked`
+    method convention). Returns `(acquired, edges, stmts)`:
+
+    - acquired: every lock token acquired anywhere in the function
+    - edges: (outer, inner, node) for each acquisition made while
+      another lock was already held — the lock-order graph's raw edges
+    - stmts: (stmt, held_tuple) for every statement, nested defs
+      excluded (their bodies run later; a surrounding `with` does not
+      guard them)
+    """
+    acquired = set()
+    edges = []
+    stmts = []
+
+    def walk(body, held):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                stmts.append((stmt, tuple(held)))
+                inner = list(held)
+                for item in stmt.items:
+                    lock_id = resolve_item(item.context_expr)
+                    if lock_id is not None:
+                        for outer in inner:
+                            edges.append((outer, lock_id, item.context_expr))
+                        inner.append(lock_id)
+                        acquired.add(lock_id)
+                walk(stmt.body, inner)
+            else:
+                stmts.append((stmt, tuple(held)))
+                for field in _COMPOUND_BODY_FIELDS:
+                    child = getattr(stmt, field, None)
+                    if child:
+                        walk(child, held)
+                for handler in getattr(stmt, "handlers", []):
+                    walk(handler.body, held)
+
+    walk(fn_node.body, list(held0))
+    return acquired, edges, stmts
+
+
+def make_lock_resolver(symbols: ModuleSymbols, cls: ClassSymbols | None):
+    """A resolve_item for `scan_function` mapping with-item expressions
+    to project-global lock ids: `self.X` through the class's lock
+    attrs, bare/dotted names through module locks and the import
+    table (resolution is name-based — `from locks import A` and the
+    defining module's own `with A:` land on the same id)."""
+
+    def resolve(expr):
+        name = dotted(expr)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self":
+            if cls is not None and len(parts) == 2 and parts[1] in cls.lock_attrs:
+                return f"{symbols.name}.{cls.name}.{parts[1]}"
+            return None
+        if len(parts) == 1 and name in symbols.module_locks:
+            return f"{symbols.name}.{name}"
+        # Imported lock: longest dotted prefix bound by an import.
+        for i in range(len(parts), 0, -1):
+            head = ".".join(parts[:i])
+            if head in symbols.imports:
+                src, symbol = symbols.imports[head]
+                rest = parts[i:]
+                if symbol is not None:
+                    rest = [symbol] + rest
+                if len(rest) == 1:
+                    return f"{src}.{rest[0]}"
+                if len(rest) > 1:
+                    return f"{src}.{'.'.join(rest)}"
+        return None
+
+    return resolve
+
+
+def _callee_key(call: ast.Call, cls: ClassSymbols | None):
+    """('self', class, method) for same-class calls, ('name', dotted)
+    for plain/imported callables, None when unresolvable."""
+    fname = dotted(call.func)
+    if fname is None:
+        return None
+    parts = fname.split(".")
+    if parts[0] == "self":
+        if cls is not None and len(parts) == 2:
+            return ("self", cls.name, parts[1])
+        return None
+    return ("name", fname)
+
+
+def _stmt_exprs(stmt):
+    """The statement's own expression roots (headers, values, targets),
+    nested statement lists excluded."""
+    roots = []
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.AST):
+            roots.append(value)
+        elif isinstance(value, list):
+            roots.extend(v for v in value if isinstance(v, ast.AST))
+    for root in roots:
+        yield from ast.walk(root)  # walk includes the root itself
+
+
+# --- per-module build ------------------------------------------------------
+
+
+def module_symbols(path: str, tree, comments: dict) -> ModuleSymbols:
+    """Build one module's symbols. `comments` maps line number -> the
+    comment text on that line (jaxlint's tokenize pass supplies it; the
+    `guarded_by:` convention is read from there)."""
+    name = module_name_for(path)
+    sym = ModuleSymbols(name=name, path=path)
+    sym.str_consts = _module_str_constants(tree)
+    sym.meshes, sym.mesh_union, sym.has_mesh = _collect_meshes(tree, sym.str_consts)
+    sym.imports = _collect_imports(tree, name)
+
+    # Module-level locks.
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fname = dotted(node.value.func)
+            if fname and fname.split(".")[-1] in LOCK_FACTORY_TAILS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        sym.module_locks.add(tgt.id)
+
+    # Classes: locks, guarded_by annotations, thread spawning.
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cls = ClassSymbols(name=node.name, module=name, node=node)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                fname = dotted(sub.func)
+                tail = fname.split(".")[-1] if fname else ""
+                if tail == "Thread":
+                    cls.spawns_thread = True
+                    for kw in sub.keywords:
+                        if kw.arg == "target":
+                            tname = dotted(kw.value)
+                            if tname and tname.startswith("self."):
+                                cls.thread_targets.add(tname.split(".", 1)[1])
+            if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                for attr, _tgt in _self_attr_writes(sub):
+                    cls.assigned_attrs.add(attr)
+                    comment = comments.get(sub.lineno, "")
+                    match = GUARDED_BY_RE.search(comment)
+                    if match:
+                        cls.guarded[attr] = match.group(1)
+                if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+                    fname = dotted(sub.value.func)
+                    if fname and fname.split(".")[-1] in LOCK_FACTORY_TAILS:
+                        for attr, _tgt in _self_attr_writes(sub):
+                            cls.lock_attrs.add(attr)
+        # A guard annotation names a lock even if its constructor is
+        # spelled indirectly; trust the contract.
+        cls.lock_attrs |= set(cls.guarded.values())
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.methods[item.name] = item
+        sym.classes[node.name] = cls
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sym.functions[node.name] = node
+
+    # Lock-order graph: direct acquisitions + calls made while holding.
+    def scan_scope(fn_node, cls, qualname):
+        resolver = make_lock_resolver(sym, cls)
+        held0 = ()
+        if cls is not None and fn_node.name.endswith(LOCKED_SUFFIX):
+            held0 = tuple(sorted(cls.lock_ids()))
+        acquired, edges, stmts = scan_function(fn_node, resolver, held0)
+        sym.func_locks[qualname] = acquired
+        for outer, inner, site in edges:
+            sym.lock_edges.append((outer, inner, site.lineno, site.col_offset))
+        for stmt, held in stmts:
+            if not held:
+                continue
+            for expr in _stmt_exprs(stmt):
+                if isinstance(expr, ast.Call):
+                    key = _callee_key(expr, cls)
+                    if key is not None:
+                        sym.lock_calls.append(
+                            (tuple(held), key, expr.lineno, expr.col_offset)
+                        )
+
+    for fname, fn_node in sym.functions.items():
+        scan_scope(fn_node, None, fname)
+    for cls in sym.classes.values():
+        for mname, mnode in cls.methods.items():
+            scan_scope(mnode, cls, f"{cls.name}.{mname}")
+    return sym
+
+
+# --- the project table -----------------------------------------------------
+
+
+class ProjectTable:
+    """Pass-1 output: every linted module's symbols, keyed by dotted
+    module name, with suffix-tolerant lookup and one-hop resolution of
+    imported meshes and callables."""
+
+    def __init__(self, modules):
+        self.modules = {}
+        for m in modules:
+            self.modules[m.name] = m
+        self._edges = None
+
+    def module(self, name: str) -> ModuleSymbols | None:
+        if name in self.modules:
+            return self.modules[name]
+        for key, mod in self.modules.items():
+            if key.endswith("." + name):
+                return mod
+        for key, mod in self.modules.items():
+            if name.endswith("." + key):
+                return mod
+        return None
+
+    def resolve_mesh(self, mod: ModuleSymbols, dotted_name: str):
+        """(axes, known) for a mesh referenced by name in `mod` —
+        locally assigned, or reached through `from x import mesh` /
+        `import x as alias; alias.mesh` chains. None = not a mesh the
+        table can see."""
+        if dotted_name in mod.meshes:
+            return mod.meshes[dotted_name]
+        parts = dotted_name.split(".")
+        for i in range(len(parts), 0, -1):
+            head = ".".join(parts[:i])
+            if head not in mod.imports:
+                continue
+            src_name, symbol = mod.imports[head]
+            rest = parts[i:]
+            if symbol is not None:
+                src = self.module(src_name)
+                if src is not None and not rest and symbol in src.meshes:
+                    return src.meshes[symbol]
+                # `from pkg import submodule` then `submodule.mesh`:
+                sub = self.module(f"{src_name}.{symbol}")
+                if sub is not None and rest and rest[0] in sub.meshes:
+                    return sub.meshes[rest[0]]
+            else:
+                src = self.module(src_name)
+                if src is not None and rest and rest[0] in src.meshes:
+                    return src.meshes[rest[0]]
+                if rest:
+                    sub = self.module(f"{src_name}.{rest[0]}")
+                    if sub is not None and len(rest) > 1 and rest[1] in sub.meshes:
+                        return sub.meshes[rest[1]]
+        return None
+
+    def callee_locks(self, mod: ModuleSymbols, callee) -> set:
+        """Locks a called function/method acquires directly — one hop,
+        resolved through the table for imported callables."""
+        kind = callee[0]
+        if kind == "self":
+            _kind, cls_name, meth = callee
+            return mod.func_locks.get(f"{cls_name}.{meth}", set())
+        _kind, fname = callee
+        if fname in mod.func_locks:
+            return mod.func_locks[fname]
+        parts = fname.split(".")
+        for i in range(len(parts), 0, -1):
+            head = ".".join(parts[:i])
+            if head not in mod.imports:
+                continue
+            src_name, symbol = mod.imports[head]
+            rest = parts[i:]
+            if symbol is not None:
+                rest = [symbol] + rest
+            src = self.module(src_name)
+            if src is not None and len(rest) == 1:
+                return src.func_locks.get(rest[0], set())
+            if src is not None and len(rest) == 2:
+                return src.func_locks.get(f"{rest[0]}.{rest[1]}", set())
+        return set()
+
+    def all_lock_edges(self):
+        """The project-wide lock-order graph: every direct nesting edge
+        plus call-through edges (a lock held across a call to code that
+        acquires another lock), as (outer, inner, module, line, col)."""
+        if self._edges is not None:
+            return self._edges
+        edges = []
+        for mod in self.modules.values():
+            for outer, inner, line, col in mod.lock_edges:
+                edges.append((outer, inner, mod.name, line, col))
+            for held, callee, line, col in mod.lock_calls:
+                for inner in sorted(self.callee_locks(mod, callee)):
+                    for outer in held:
+                        edges.append((outer, inner, mod.name, line, col))
+        self._edges = edges
+        return edges

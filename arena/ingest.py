@@ -152,25 +152,28 @@ class MergeableCSR:
         # the shared no-op instance, so an uninstrumented store pays a
         # constant-time null call per batch, never a measurement.
         self._obs = obs if obs is not None else NULL_OBS
-        self.num_matches = 0
-        self.compactions = 0
         # One lock covers every mutation AND clone(): the pipeline's
         # packer thread merges batches under it, so a concurrent
         # clone()/grouping() from another thread always snapshots a
         # consistent structure (never mid-compaction). RLock because
-        # grouping() compacts and add() may compact.
+        # grouping() compacts and add() may compact. The `guarded_by`
+        # annotations below are the jaxlint contract: every write to
+        # these attributes outside __init__ must hold this lock
+        # (`unguarded-shared-write` polices it statically).
         self._lock = threading.RLock()
+        self.num_matches = 0  # guarded_by: _lock
+        self.compactions = 0  # guarded_by: _lock
         # Main sorted runs: keys ascending player id, pos the
         # interleaved entry positions in that order.
-        self._keys = np.empty(0, np.int32)
-        self._pos = np.empty(0, np.int32)
+        self._keys = np.empty(0, np.int32)  # guarded_by: _lock
+        self._pos = np.empty(0, np.int32)  # guarded_by: _lock
         # Delta tail: per-batch sorted runs not yet merged into main.
-        self._tail_keys = []
-        self._tail_pos = []
-        self._tail_entries = 0
+        self._tail_keys = []  # guarded_by: _lock
+        self._tail_pos = []  # guarded_by: _lock
+        self._tail_entries = 0  # guarded_by: _lock
         # Match history, capacity-doubled so add() is amortized O(d).
-        self._w = np.empty(1024, np.int32)
-        self._l = np.empty(1024, np.int32)
+        self._w = np.empty(1024, np.int32)  # guarded_by: _lock
+        self._l = np.empty(1024, np.int32)  # guarded_by: _lock
 
     def _reserve(self, n):
         need = self.num_matches + n
@@ -440,12 +443,15 @@ class StagingBuffers:
         self.depth = depth
         self._dtype = dtype
         self._obs = obs if obs is not None else NULL_OBS
-        self._rings = {}  # bucket -> list of slots
-        self._next = {}  # bucket -> rotation index
+        # The packer thread stages while the dispatching thread
+        # releases: ring state and the in-flight queue share this
+        # condition's lock (guarded_by = the jaxlint contract).
         self._cond = threading.Condition()
-        self._inflight = deque()  # slots in stage order, until release()
-        self.slots_allocated = 0
-        self.stages = 0
+        self._rings = {}  # guarded_by: _cond  (bucket -> list of slots)
+        self._next = {}  # guarded_by: _cond  (bucket -> rotation index)
+        self._inflight = deque()  # guarded_by: _cond  (stage order, until release())
+        self.slots_allocated = 0  # guarded_by: _cond
+        self.stages = 0  # single-writer: only the staging thread bumps it
 
     def in_flight(self):
         """Slots staged but not yet release()d."""

@@ -127,30 +127,34 @@ class FrontDoor:
                 f"max_staleness_matches must be >= 0, got {max_staleness_matches}"
             )
         self._eng = engine
-        self.capacity = capacity
-        self.max_staleness_matches = max_staleness_matches
-        self.policy = POLICY_COALESCE
+        # N producer threads and the merge worker meet under this one
+        # condition; every attribute annotated below is part of that
+        # shared state, and the `guarded_by` annotations make the
+        # jaxlint `unguarded-shared-write` rule enforce it statically.
         self._cv = threading.Condition()
-        self._next_seq = 0  # next sequence number to assign (admission)
-        self._next_apply = 0  # next sequence number the merge may apply
-        self._buffer = {}  # seq -> _Ticket, delivered but not applied
-        self._summary = deque()  # (producer, winners, losers) shed segments
-        self._summary_matches = 0
-        self._applying = False  # worker holds a popped item right now
-        self._closed = False
-        self._held = False  # pause() — the forced-overload hook
-        self._error = None
-        self.admitted_batches = 0
-        self.admitted_matches = 0
-        self.delivered_batches = 0
-        self.applied_batches = 0
-        self.applied_matches = 0
-        self.shed_batches = 0  # coalesced into the summary (matches kept)
-        self.shed_matches = 0
-        self.dropped_matches = 0  # trimmed from the summary (really lost)
-        self.summaries_applied = 0
-        self.max_staleness_seen = 0
-        self._producer_pending = {}  # producer -> batches in the buffer
+        self.capacity = capacity  # guarded_by: _cv  (set_policy retunes live)
+        self.max_staleness_matches = max_staleness_matches  # guarded_by: _cv
+        self.policy = POLICY_COALESCE
+        self._next_seq = 0  # guarded_by: _cv  (next seq to assign at admission)
+        self._next_apply = 0  # guarded_by: _cv  (next seq the merge may apply)
+        self._buffer = {}  # guarded_by: _cv  (seq -> _Ticket, not applied)
+        self._summary = deque()  # guarded_by: _cv  (shed segments)
+        self._summary_matches = 0  # guarded_by: _cv
+        self._applying = False  # guarded_by: _cv  (worker holds a popped item)
+        self._closed = False  # guarded_by: _cv
+        self._held = False  # guarded_by: _cv  (pause() — forced-overload hook)
+        self._error = None  # guarded_by: _cv
+        self.admitted_batches = 0  # guarded_by: _cv
+        self.admitted_matches = 0  # guarded_by: _cv
+        self.delivered_batches = 0  # guarded_by: _cv
+        self.applied_batches = 0  # guarded_by: _cv
+        self.applied_matches = 0  # guarded_by: _cv
+        self.shed_batches = 0  # guarded_by: _cv  (coalesced, matches kept)
+        self.shed_matches = 0  # guarded_by: _cv
+        self.dropped_matches = 0  # guarded_by: _cv  (summary trims, really lost)
+        self.summaries_applied = 0  # guarded_by: _cv
+        self.max_staleness_seen = 0  # guarded_by: _cv
+        self._producer_pending = {}  # guarded_by: _cv  (producer -> buffered)
         # Matches the engine had applied before this front door opened:
         # staleness_matches() measures OUR backlog, not history's.
         self._base_applied = engine.matches_applied

@@ -63,8 +63,10 @@ class Counter:
     def __init__(self, name, labels):
         self.name = name
         self.labels = labels
-        self._buf = np.zeros(1, np.int64)  # preallocated, never resized
         self._lock = threading.Lock()
+        # Preallocated, never resized; the per-metric lock is what
+        # makes concurrent inc() exact (guarded_by = jaxlint contract).
+        self._buf = np.zeros(1, np.int64)  # guarded_by: _lock
 
     def inc(self, n=1):
         with self._lock:
@@ -83,8 +85,8 @@ class Gauge:
     def __init__(self, name, labels):
         self.name = name
         self.labels = labels
-        self._buf = np.zeros(1, np.float64)
         self._lock = threading.Lock()
+        self._buf = np.zeros(1, np.float64)  # guarded_by: _lock
 
     def set(self, v):
         with self._lock:
@@ -129,13 +131,13 @@ class Histogram:
         self.labels = labels
         self.base = base
         self.bounds = base * np.exp2(np.arange(num_buckets, dtype=np.float64))
-        self._counts = np.zeros(num_buckets + 1, np.int64)  # [+Inf] last
-        self._sum = np.zeros(1, np.float64)
-        self._count = np.zeros(1, np.int64)
-        # Latest-wins exemplar per bucket: trace id 0 = no exemplar.
-        self._ex_trace = np.zeros(num_buckets + 1, np.int64)
-        self._ex_value = np.zeros(num_buckets + 1, np.float64)
         self._lock = threading.Lock()
+        self._counts = np.zeros(num_buckets + 1, np.int64)  # guarded_by: _lock ([+Inf] last)
+        self._sum = np.zeros(1, np.float64)  # guarded_by: _lock
+        self._count = np.zeros(1, np.int64)  # guarded_by: _lock
+        # Latest-wins exemplar per bucket: trace id 0 = no exemplar.
+        self._ex_trace = np.zeros(num_buckets + 1, np.int64)  # guarded_by: _lock
+        self._ex_value = np.zeros(num_buckets + 1, np.float64)  # guarded_by: _lock
 
     def bucket_index(self, value):
         """First bucket whose upper bound is >= value (le semantics);
@@ -263,8 +265,8 @@ class Registry:
     enabled = True
 
     def __init__(self):
-        self._metrics = {}
         self._lock = threading.Lock()
+        self._metrics = {}  # guarded_by: _lock  (get-or-create only)
 
     def _get(self, cls, name, labels, **kwargs):
         key = (name, tuple(sorted(labels.items())))

@@ -122,24 +122,31 @@ class IngestPipeline:
         # per-producer streams by this label instead of renaming the
         # counters/gauges later.
         self.producer = producer
+        # One condition guards the queues, lifecycle flags, and the
+        # counters below — producer threads, the packer, and whichever
+        # thread dispatches all touch them. The `guarded_by`
+        # annotations are the jaxlint `unguarded-shared-write`
+        # contract: any write outside __init__ must hold `_cv`.
         self._cv = threading.Condition()
-        self._raw = deque()  # (winners, losers, trace ctx), not yet packed
-        self._ready = deque()  # (staged PackedBatch, trace ctx), not dispatched
+        self._raw = deque()  # guarded_by: _cv  ((winners, losers, ctx), not packed)
+        self._ready = deque()  # guarded_by: _cv  ((PackedBatch, ctx), not dispatched)
         # Serializes pop-from-ready + apply so concurrent dispatchers
         # (submit draining while flush drains) keep FIFO order.
         self._dispatch_lock = threading.Lock()
-        self._closed = False
-        self._packing = False  # packer holds a popped batch right now
-        self._error = None
-        self.submitted = 0
-        self.completed = 0
-        self.dropped_batches = 0
-        self.dropped_matches = 0
-        self.spilled_batches = 0
-        self.spilled_matches = 0
+        self._closed = False  # guarded_by: _cv
+        self._packing = False  # guarded_by: _cv  (packer holds a popped batch)
+        self._error = None  # guarded_by: _cv
+        self.submitted = 0  # guarded_by: _cv
+        self.completed = 0  # guarded_by: _cv
+        self.dropped_batches = 0  # guarded_by: _cv
+        self.dropped_matches = 0  # guarded_by: _cv
+        self.spilled_batches = 0  # guarded_by: _cv
+        self.spilled_matches = 0  # guarded_by: _cv
         # Host-pack vs device-dispatch breakdown (the bench reports it).
+        # host_pack_s is packer-thread-private; dispatch_s is serialized
+        # by the dispatch lock, not the condition.
         self.host_pack_s = 0.0
-        self.dispatch_s = 0.0
+        self.dispatch_s = 0.0  # guarded_by: _dispatch_lock
         self._thread = threading.Thread(
             target=self._pack_loop, name="arena-ingest-packer", daemon=True
         )

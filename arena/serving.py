@@ -713,7 +713,11 @@ class ArenaServer:
                         f"snapshot raced an ingest for 10s: {watermark} "
                         f"matches applied vs {state['num_matches']} stored"
                     )
-                time.sleep(0.001)
+                # Deliberate: the serving lock must stay held while the
+                # watermark settles (a view refresh mid-snapshot would
+                # serve half-written state); reads never take this lock,
+                # so only writers wait, bounded by the deadline above.
+                time.sleep(0.001)  # jaxlint: disable=blocking-while-locked
             manifest = write_snapshot(
                 path,
                 num_players=eng.num_players,

@@ -62,6 +62,9 @@ COPIED = (
     "BENCH_BASELINE.json",
     "PAPERS.md",
     "SNIPPETS.md",
+    # The jaxlint selfcheck greps README's rule table against the live
+    # registry (doc/code drift tripwire), so the doc rides along.
+    "README.md",
     "pytest.ini",
     "arena",
     "tests",
@@ -488,6 +491,57 @@ MUTATIONS = (
         "the use-after-donate rule must track buffers through donating "
         "calls; dropping the poisoning step makes every reuse-after-donate "
         "invisible — the corpus test must catch it",
+    ),
+    (
+        "lint-symbol-table-skips-imports",
+        "arena/analysis/project.py",
+        "            for alias in node.names:\n"
+        "                imports[alias.asname or alias.name] = (module, alias.name)",
+        "            for alias in node.names:\n"
+        "                continue  # from-imports deliberately skipped",
+        "the v2 symbol table's import half IS the cross-module capability: "
+        "with `from x import y` bindings dropped, a mesh defined in module A "
+        "can never resolve from module B and sharding-spec-arity silently "
+        "reverts to the v1 per-file blindness ROADMAP item 3 names — killed "
+        "by test_symbol_table_resolves_from_imports (and the cross-module "
+        "mesh fixture tests)",
+    ),
+    (
+        "lint-guarded-write-check-ignores-with-blocks",
+        "arena/analysis/project.py",
+        "                        inner.append(lock_id)\n"
+        "                        acquired.add(lock_id)",
+        "                        acquired.add(lock_id)",
+        "the held-lock scanner must treat `with self._lock:` bodies as held "
+        "regions; without the push every correctly-locked write in the four "
+        "annotated production modules reads as unguarded and the clean-tree "
+        "gate goes red — killed by "
+        "test_guarded_write_inside_with_lock_block_is_clean (and "
+        "test_full_tree_lints_clean_with_concurrency_rules_active)",
+    ),
+    (
+        "lint-lock-order-graph-edges-dropped",
+        "arena/analysis/project.py",
+        "                        for outer in inner:\n"
+        "                            edges.append((outer, lock_id, item.context_expr))",
+        "                        for outer in inner:\n"
+        "                            pass  # nesting edges deliberately dropped",
+        "the lock-order graph's nesting edges are the inversion rule's raw "
+        "material; with them dropped, opposite lock orders across modules "
+        "(the deadlock class) lint clean — killed by "
+        "test_lock_order_inversion_detected_across_modules (and the "
+        "bad_lock_order corpus contract)",
+    ),
+    (
+        "lint-json-format-omits-rule-name",
+        "arena/analysis/jaxlint.py",
+        '        "rule": finding.rule,\n        "path": finding.path,',
+        '        "path": finding.path,',
+        "the --format=json contract is one finding per line with the rule "
+        "NAME in the object — a consumer (CI, the perf watchdog) that cannot "
+        "tell which rule fired cannot gate on it — killed by "
+        "test_json_format_lines_carry_rule (and the CLI subprocess schema "
+        "check)",
     ),
 )
 

@@ -11,6 +11,7 @@ real `python -m arena.analysis` entrypoint because that is the
 documented operator command.
 """
 
+import json
 import pathlib
 import subprocess
 import sys
@@ -37,6 +38,11 @@ CORPUS_EXPECTED = {
     "bad_jnp_host.py": {"jnp-on-host-path"},
     "bad_handler_host_path.py": {"jnp-on-host-path"},
     "bad_sharding_spec.py": {"sharding-spec-arity"},
+    # jaxlint v2: the concurrency lock-discipline analyzer.
+    "bad_unguarded_write.py": {"unguarded-shared-write"},
+    "bad_blocking_locked.py": {"blocking-while-locked"},
+    "bad_lock_order.py": {"lock-order-inversion"},
+    "bad_liveness_recheck.py": {"thread-no-liveness-recheck"},
 }
 
 
@@ -91,6 +97,7 @@ def test_default_targets_cover_the_ingest_and_pipeline_modules():
         "obs/context.py", "obs/debug.py", "obs/regress.py",
         "net/__init__.py", "net/protocol.py", "net/frontdoor.py",
         "net/server.py",
+        "analysis/project.py", "analysis/concurrency.py",
     ):
         path = str(REPO / "arena" / mod)
         assert path in walked, f"default targets no longer cover arena/{mod}"
@@ -221,6 +228,104 @@ def test_inline_suppression_mutes_only_the_named_rule():
     assert jaxlint.lint_source(mute_all, "t.py") == []
 
 
+def test_suppression_covers_decorated_def_header():
+    """Regression (v2 satellite): the finding points at the in_specs
+    line INSIDE a multi-line decorator; the directive sits on the `def`
+    line — the enclosing statement's header. v1 matched only the
+    flagged line, so this exact comment was silently ignored."""
+    src = (
+        "from functools import partial\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "from jax.sharding import Mesh\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "mesh = Mesh(np.array(jax.devices()), ('data',))\n"
+        "@partial(\n"
+        "    shard_map,\n"
+        "    mesh=mesh,\n"
+        "    in_specs=(P('model'),),\n"
+        "    out_specs=P(),\n"
+        ")\n"
+        "def f(x):\n"
+        "    return x\n"
+    )
+    assert {f.rule for f in jaxlint.lint_source(src, "d.py")} == {
+        "sharding-spec-arity"
+    }
+    muted = src.replace(
+        "def f(x):", "def f(x):  # jaxlint: disable=sharding-spec-arity"
+    )
+    assert jaxlint.lint_source(muted, "d.py") == []
+    wrong_rule = src.replace(
+        "def f(x):", "def f(x):  # jaxlint: disable=mutable-closure"
+    )
+    assert jaxlint.lint_source(wrong_rule, "d.py") != []
+
+
+def test_suppression_covers_wrapped_with_header():
+    """Regression (v2 satellite): the poisoned read sits on an inner
+    line of a wrapped `with` header; the directive sits after the
+    closing colon. The directive covers the statement HEADER only — a
+    violation in the with BODY must still fire."""
+    src = (
+        "import jax\n"
+        "f = jax.jit(lambda s, d: s + d, donate_argnums=(0,))\n"
+        "def g(state, delta, ctx_over):\n"
+        "    f(state, delta)\n"
+        "    with ctx_over(\n"
+        "        state\n"
+        "    ):  # jaxlint: disable=use-after-donate\n"
+        "        pass\n"
+    )
+    assert jaxlint.lint_source(src, "w.py") == []
+    unmuted = src.replace("  # jaxlint: disable=use-after-donate", "")
+    findings = jaxlint.lint_source(unmuted, "w.py")
+    assert {f.rule for f in findings} == {"use-after-donate"}
+    assert findings[0].line == 6  # the read is on the wrapped header line
+    # The directive must NOT leak into the body.
+    body_violation = src.replace("        pass\n", "        h = state\n")
+    assert {f.rule for f in jaxlint.lint_source(body_violation, "w.py")} == {
+        "use-after-donate"
+    }
+
+
+def test_json_format_lines_carry_rule(capsys):
+    """`--format=json`: one JSON object per finding per line with the
+    full mechanical schema — a consumer greps rc and parses lines, no
+    human-format scraping."""
+    rc = jaxlint.main(
+        ["--format=json", str(CORPUS / "bad_use_after_donate.py")]
+    )
+    assert rc == 1
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert lines
+    for line in lines:
+        obj = json.loads(line)
+        assert set(obj) == {"rule", "path", "line", "col", "message",
+                            "suppressed"}
+        assert obj["rule"] == "use-after-donate"
+        assert obj["suppressed"] is False
+
+
+def test_json_format_flags_suppressed_findings_rc_unchanged(tmp_path, capsys):
+    """Suppressed findings appear in JSON output flagged
+    suppressed=true and do NOT flip the exit code — rc semantics are
+    identical across formats."""
+    bad = (CORPUS / "bad_timing.py").read_text().replace(
+        "elapsed = time.perf_counter() - t0",
+        "elapsed = time.perf_counter() - t0  # jaxlint: disable=timing-without-block",
+    )
+    target = tmp_path / "muted.py"
+    target.write_text(bad)
+    rc = jaxlint.main(["--format=json", str(target)])
+    assert rc == 0  # suppressed-only: clean exit, same as human format
+    lines = capsys.readouterr().out.strip().splitlines()
+    objs = [json.loads(line) for line in lines]
+    assert objs and all(o["suppressed"] is True for o in objs)
+    assert {o["rule"] for o in objs} == {"timing-without-block"}
+
+
 def test_syntax_error_is_a_finding_not_a_crash():
     findings = jaxlint.lint_source("def broken(:\n", "b.py")
     assert len(findings) == 1
@@ -302,3 +407,21 @@ def test_cli_subprocess_contract():
     )
     assert corpus.returncode == 1
     assert "use-after-donate" in corpus.stdout
+    # --format=json over the same corpus: identical rc, every stdout
+    # line a JSON object with the pinned schema (the satellite's
+    # machine-consumption contract, end to end).
+    as_json = subprocess.run(
+        [
+            sys.executable, "-m", "arena.analysis", "--format=json",
+            "arena/analysis/badcorpus",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert as_json.returncode == 1
+    json_lines = [json.loads(line) for line in as_json.stdout.splitlines()]
+    assert json_lines
+    assert all(
+        set(obj) == {"rule", "path", "line", "col", "message", "suppressed"}
+        for obj in json_lines
+    )
+    assert {obj["rule"] for obj in json_lines} == set(jaxlint.RULES)

@@ -1,0 +1,392 @@
+"""jaxlint v2 cross-module engine: the symbol table resolves imports,
+meshes, locks, and `guarded_by` contracts ACROSS modules, and the
+concurrency rules stand on it.
+
+The named kill-tests for the four v2 mutation-audit mutants live here
+and in test_analysis_lint.py:
+
+- symbol-table-skips-imports       -> test_symbol_table_resolves_from_imports
+                                      (+ the cross-module mesh fixture)
+- guarded-write-check-ignores-with-blocks
+                                   -> test_guarded_write_inside_with_lock_block_is_clean
+- lock-order-graph-edges-dropped   -> test_lock_order_inversion_detected_across_modules
+- json-format-omits-rule-name      -> test_json_format_lines_carry_rule
+                                      (test_analysis_lint.py)
+"""
+
+import ast
+import pathlib
+
+from arena.analysis import jaxlint, project
+
+MESH_SRC = (
+    "import jax\n"
+    "import numpy as np\n"
+    "from jax.sharding import Mesh\n"
+    "AXIS = 'data'\n"
+    "mesh = Mesh(np.array(jax.devices()), (AXIS,))\n"
+)
+
+SHARD_SRC = (
+    "from functools import partial\n"
+    "from jax.experimental.shard_map import shard_map\n"
+    "from jax.sharding import PartitionSpec as P\n"
+    "from meshes import mesh\n"
+    "@partial(shard_map, mesh=mesh, in_specs=(P('model'),), out_specs=P())\n"
+    "def f(x):\n"
+    "    return x\n"
+)
+
+
+def _symbols(path, src):
+    _table, comments = jaxlint._comment_tables(src)
+    return project.module_symbols(str(path), ast.parse(src), comments)
+
+
+# --- the symbol table -------------------------------------------------
+
+
+def test_symbol_table_resolves_from_imports(tmp_path):
+    """The table's import half IS the cross-module capability: a
+    `from meshes import mesh` binding in module B resolves to the mesh
+    (and its axis names) DEFINED in module A."""
+    compute = _symbols(tmp_path / "compute.py", SHARD_SRC)
+    assert compute.imports["mesh"] == ("meshes", "mesh")
+    meshes = _symbols(tmp_path / "meshes.py", MESH_SRC)
+    assert meshes.meshes["mesh"] == (frozenset({"data"}), True)
+    table = project.ProjectTable([compute, meshes])
+    axes, known = table.resolve_mesh(compute, "mesh")
+    assert known and set(axes) == {"data"}
+
+
+def test_symbol_table_resolves_module_alias_attribute_chains(tmp_path):
+    src = "import meshes as m\n"
+    mod = _symbols(tmp_path / "user.py", src)
+    meshes = _symbols(tmp_path / "meshes.py", MESH_SRC)
+    table = project.ProjectTable([mod, meshes])
+    axes, known = table.resolve_mesh(mod, "m.mesh")
+    assert known and set(axes) == {"data"}
+
+
+def test_module_names_derive_from_package_layout():
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    assert project.module_name_for(str(repo / "arena" / "ingest.py")) == (
+        "arena.ingest"
+    )
+    assert project.module_name_for(str(repo / "arena" / "net" / "__init__.py")) == (
+        "arena.net"
+    )
+    assert project.module_name_for("/tmp/somewhere/a.py") == "a"
+
+
+def test_guarded_by_annotations_collected_from_comments(tmp_path):
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0  # guarded_by: _lock\n"
+        "        self.free = 0\n"
+    )
+    sym = _symbols(tmp_path / "c.py", src)
+    cls = sym.classes["C"]
+    assert cls.guarded == {"n": "_lock"}
+    assert cls.lock_attrs == {"_lock"}
+    assert {"n", "free", "_lock"} <= cls.assigned_attrs
+
+
+def test_symbol_table_sees_the_real_guarded_contracts():
+    """The annotations in the four production modules are VISIBLE to
+    the engine — the clean-tree pass is a real concurrency contract,
+    not a vacuous one (tentpole acceptance)."""
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    expected = {
+        "arena/ingest.py": ("MergeableCSR", "_lock", "num_matches"),
+        "arena/pipeline.py": ("IngestPipeline", "_cv", "submitted"),
+        "arena/obs/metrics.py": ("Histogram", "_lock", "_counts"),
+        "arena/net/frontdoor.py": ("FrontDoor", "_cv", "_buffer"),
+    }
+    for rel, (cls_name, lock, attr) in expected.items():
+        path = repo / rel
+        sym = _symbols(path, path.read_text())
+        cls = sym.classes[cls_name]
+        assert cls.guarded.get(attr) == lock, (rel, cls.guarded)
+
+
+# --- cross-module mesh resolution (the ROADMAP item 3 gap) ------------
+
+
+def test_cross_module_mesh_resolution_fires_sharding_rule(tmp_path):
+    """Mesh in module A, shard_map in module B: v1 silently passed
+    (axis names unknowable per-file); the two-pass engine resolves the
+    imported mesh and fires on the inconsistent spec."""
+    (tmp_path / "meshes.py").write_text(MESH_SRC)
+    (tmp_path / "compute.py").write_text(SHARD_SRC)
+    findings = jaxlint.lint_paths([str(tmp_path)])
+    assert [(f.rule, pathlib.Path(f.path).name) for f in findings] == [
+        ("sharding-spec-arity", "compute.py")
+    ]
+    assert "'model'" in findings[0].message
+
+
+def test_cross_module_mesh_resolution_quiet_when_consistent(tmp_path):
+    (tmp_path / "meshes.py").write_text(MESH_SRC)
+    (tmp_path / "compute.py").write_text(SHARD_SRC.replace("'model'", "'data'"))
+    assert jaxlint.lint_paths([str(tmp_path)]) == []
+
+
+def test_single_file_walk_still_quiet_without_defining_module(tmp_path):
+    """Linting B alone cannot know A's axes — the rule must stay
+    quiet rather than guess (the documented v1 behavior the project
+    pass upgrades on)."""
+    (tmp_path / "compute.py").write_text(SHARD_SRC)
+    assert jaxlint.lint_paths([str(tmp_path / "compute.py")]) == []
+
+
+# --- unguarded-shared-write -------------------------------------------
+
+
+GUARDED_CLASS = (
+    "import threading\n"
+    "class C:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.n = 0  # guarded_by: _lock\n"
+    "        self._thread = threading.Thread(target=self._run)\n"
+    "    def _run(self):\n"
+    "        with self._lock:\n"
+    "            self.n += 1\n"
+)
+
+
+def test_guarded_write_inside_with_lock_block_is_clean():
+    """Writes lexically inside `with self._lock:` satisfy the
+    contract; the SAME write outside it fires. (Kills the
+    guarded-write-check-ignores-with-blocks mutant: if held-region
+    tracking drops with-blocks, the clean half goes red.)"""
+    assert jaxlint.lint_source(GUARDED_CLASS, "c.py") == []
+    racy = GUARDED_CLASS + "    def bump(self):\n        self.n += 2\n"
+    findings = jaxlint.lint_source(racy, "c.py")
+    assert [f.rule for f in findings] == ["unguarded-shared-write"]
+    assert "guarded_by: _lock" in findings[0].message
+
+
+def test_locked_suffix_methods_are_held_regions():
+    """The repo's `*_locked` naming convention (called with the lock
+    held) is honored — and a non-suffixed helper with the same body is
+    not."""
+    locked = GUARDED_CLASS + "    def _bump_locked(self):\n        self.n += 2\n"
+    assert jaxlint.lint_source(locked, "c.py") == []
+    helper = GUARDED_CLASS + "    def bump_helper(self):\n        self.n += 2\n"
+    assert jaxlint.lint_source(helper, "c.py") != []
+
+
+def test_init_writes_are_pre_publication():
+    """__init__ writes need no lock (nothing else can hold a reference
+    yet) — annotating in __init__ must not flag __init__ itself."""
+    assert jaxlint.lint_source(GUARDED_CLASS, "c.py") == []
+
+
+def test_subscript_and_augmented_writes_count():
+    racy = GUARDED_CLASS.replace(
+        "        self.n = 0  # guarded_by: _lock\n",
+        "        self.n = {}  # guarded_by: _lock\n",
+    ) + "    def poke(self, k):\n        self.n[k] = 1\n"
+    assert [f.rule for f in jaxlint.lint_source(racy, "c.py")] == [
+        "unguarded-shared-write"
+    ]
+
+
+# --- blocking-while-locked --------------------------------------------
+
+
+def test_blocking_calls_flagged_only_under_held_locks():
+    src = (
+        "import threading\n"
+        "import time\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def bad(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)\n"
+        "    def ok(self):\n"
+        "        time.sleep(0.1)\n"
+        "        with self._lock:\n"
+        "            pass\n"
+    )
+    findings = jaxlint.lint_source(src, "c.py")
+    assert [(f.rule, f.line) for f in findings] == [("blocking-while-locked", 8)]
+
+
+def test_condition_wait_and_str_join_are_not_blocking_violations():
+    """`cond.wait()` RELEASES the lock (the sanctioned shape) and
+    `str.join(iterable)` has a positional arg — neither may fire."""
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self.done = False\n"
+        "    def wait_done(self):\n"
+        "        with self._cv:\n"
+        "            while not self.done:\n"
+        "                self._cv.wait(0.05)\n"
+        "            return ', '.join(['a', 'b'])\n"
+    )
+    assert jaxlint.lint_source(src, "c.py") == []
+
+
+# --- lock-order-inversion ---------------------------------------------
+
+
+LOCKS_SRC = (
+    "import threading\n"
+    "LOCK_A = threading.Lock()\n"
+    "LOCK_B = threading.Lock()\n"
+)
+
+
+def test_lock_order_inversion_detected_across_modules(tmp_path):
+    """Module m1 nests A->B, module m2 nests B->A: only the PROJECT
+    lock-order graph can see the cycle (neither file is wrong alone).
+    Kills the lock-order-graph-edges-dropped mutant."""
+    (tmp_path / "locks.py").write_text(LOCKS_SRC)
+    (tmp_path / "m1.py").write_text(
+        "from locks import LOCK_A, LOCK_B\n"
+        "def f():\n"
+        "    with LOCK_A:\n"
+        "        with LOCK_B:\n"
+        "            pass\n"
+    )
+    (tmp_path / "m2.py").write_text(
+        "from locks import LOCK_A, LOCK_B\n"
+        "def g():\n"
+        "    with LOCK_B:\n"
+        "        with LOCK_A:\n"
+        "            pass\n"
+    )
+    findings = jaxlint.lint_paths([str(tmp_path)])
+    assert {f.rule for f in findings} == {"lock-order-inversion"}
+    assert {pathlib.Path(f.path).name for f in findings} == {"m1.py", "m2.py"}
+
+
+def test_consistent_lock_order_across_modules_is_clean(tmp_path):
+    (tmp_path / "locks.py").write_text(LOCKS_SRC)
+    for name in ("m1.py", "m2.py"):
+        (tmp_path / name).write_text(
+            "from locks import LOCK_A, LOCK_B\n"
+            f"def f_{name[:2]}():\n"
+            "    with LOCK_A:\n"
+            "        with LOCK_B:\n"
+            "            pass\n"
+        )
+    assert jaxlint.lint_paths([str(tmp_path)]) == []
+
+
+def test_lock_order_sees_call_through_acquisitions(tmp_path):
+    """A lock held across a call into a function that takes another
+    lock contributes an edge (one hop, import-resolved) — the shape a
+    purely lexical scan misses."""
+    (tmp_path / "locks.py").write_text(LOCKS_SRC)
+    (tmp_path / "helper.py").write_text(
+        "from locks import LOCK_B\n"
+        "def locked_b():\n"
+        "    with LOCK_B:\n"
+        "        pass\n"
+    )
+    (tmp_path / "m1.py").write_text(
+        "from locks import LOCK_A\n"
+        "from helper import locked_b\n"
+        "def f():\n"
+        "    with LOCK_A:\n"
+        "        locked_b()\n"
+    )
+    (tmp_path / "m2.py").write_text(
+        "from locks import LOCK_A, LOCK_B\n"
+        "def g():\n"
+        "    with LOCK_B:\n"
+        "        with LOCK_A:\n"
+        "            pass\n"
+    )
+    findings = jaxlint.lint_paths([str(tmp_path)])
+    assert {f.rule for f in findings} == {"lock-order-inversion"}
+    assert "m1.py" in {pathlib.Path(f.path).name for f in findings}
+
+
+def test_rlock_reentry_is_not_an_inversion():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            with self._lock:\n"
+        "                pass\n"
+    )
+    assert jaxlint.lint_source(src, "c.py") == []
+
+
+# --- thread-no-liveness-recheck ---------------------------------------
+
+
+WAITER_SRC = (
+    "import threading\n"
+    "class W:\n"
+    "    def __init__(self):\n"
+    "        self._cv = threading.Condition()\n"
+    "        self.done = False\n"
+    "        self._thread = threading.Thread(target=self._run, daemon=True)\n"
+    "        self._thread.start()\n"
+    "    def _run(self):\n"
+    "        with self._cv:\n"
+    "            self.done = True\n"
+    "            self._cv.notify_all()\n"
+)
+
+
+def test_wait_loop_without_liveness_recheck_fires():
+    src = WAITER_SRC + (
+        "    def flush(self):\n"
+        "        with self._cv:\n"
+        "            while not self.done:\n"
+        "                self._cv.wait(0.05)\n"
+    )
+    assert [f.rule for f in jaxlint.lint_source(src, "w.py")] == [
+        "thread-no-liveness-recheck"
+    ]
+
+
+def test_wait_loop_with_helper_liveness_check_is_clean():
+    """The `_check_packer_locked` shape: the loop calls a same-class
+    helper whose body reads `.is_alive` — one hop resolved, quiet."""
+    src = WAITER_SRC + (
+        "    def _check_worker(self):\n"
+        "        if not self._thread.is_alive():\n"
+        "            raise RuntimeError('worker died')\n"
+        "    def flush(self):\n"
+        "        with self._cv:\n"
+        "            while not self.done:\n"
+        "                self._check_worker()\n"
+        "                self._cv.wait(0.05)\n"
+    )
+    assert jaxlint.lint_source(src, "w.py") == []
+
+
+def test_thread_target_wait_loops_are_exempt():
+    """The worker waiting for WORK needs no liveness check on itself."""
+    src = (
+        "import threading\n"
+        "class W:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self.jobs = []\n"
+        "        self._thread = threading.Thread(target=self._run, daemon=True)\n"
+        "        self._thread.start()\n"
+        "    def _run(self):\n"
+        "        with self._cv:\n"
+        "            while not self.jobs:\n"
+        "                self._cv.wait()\n"
+    )
+    assert jaxlint.lint_source(src, "w.py") == []

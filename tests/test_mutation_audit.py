@@ -64,12 +64,16 @@ def test_mutations_cover_every_policed_surface():
     PR 7 the diagnosis layer (exemplar bucket placement, the flight
     recorder's registry dump, the watchdog's tolerance direction), and
     since PR 9 the network tier (sequence order at the merge, the
-    shed-coalesce summary update, the wire response envelope)."""
+    shed-coalesce summary update, the wire response envelope), and since
+    PR 10 the jaxlint v2 engine (the symbol table's import resolution,
+    the held-lock scanner's with-block tracking, the lock-order graph's
+    edges, the JSON output schema)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
         "verify_reference.py",
         "arena/analysis/jaxlint.py",
+        "arena/analysis/project.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",
@@ -104,6 +108,7 @@ def _fake_sources_only(dest):
         "bench.py",
         "verify_reference.py",
         "arena/analysis/jaxlint.py",
+        "arena/analysis/project.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",
