@@ -1,0 +1,1001 @@
+"""Abstract interpretation: the value-flow half of jaxlint v3.
+
+jaxlint v1/v2 matched *syntax* — a dotted name here, a decorator there
+— so the invariants that are properties of VALUES stayed invisible: an
+array whose shape was derived from a raw input length three
+assignments ago, an int64 array born from a bare `np.arange` two
+helpers away, untrusted wire bytes flowing into an engine mutation.
+This module is the fix: a forward abstract interpretation over the
+stdlib `ast` that propagates an abstract value lattice through
+assignments and calls — intraprocedurally per scope, and
+interprocedurally ONE HOP through the project symbol table's call
+edges (the same resolution depth the lock-order analyzer uses).
+
+The lattice (`AbsValue`) tracks, per value:
+
+- **shape provenance** — `constant(k)` (a literal size), `padded(b)`
+  (explicitly padded to a constant), `pow2-bucketed` (produced by a
+  recognized bucketing op: `bucket_size`, `next_pow2`, `pack_batch`,
+  `pack_epoch`, `chunk_layout`, a staging `stage`, `np.pad`), or
+  `dynamic` (derived from a raw input length: `len(x)`, `x.shape[0]`,
+  `.size` off an ingest array). Join is by rank; two different
+  same-rank constants join to `bucketed` (still a finite shape set),
+  anything joined with `dynamic` is `dynamic`.
+- **dtype** — concrete (`int32`, `float32`, ...) when an explicit
+  dtype was seen, the 64-bit defaults (`int64`/`float64`) for the bare
+  NumPy constructors that produce them, `py64` for Python numbers out
+  of `json.loads` (which `np.asarray` silently widens to 64-bit), or
+  unknown (no claim).
+- **kind** — scalar vs array, so the array-shape rule and v1's
+  scalar `nonstatic-shape-arg` rule never double-report one hazard.
+- **tainted** — set by wire-input sources (`self.rfile`,
+  `self.headers`, a request handler's `self.path`, `parse_qs`),
+  propagated through arithmetic/indexing/unknown calls, cleared ONLY
+  by the recognized sanitizers (`protocol.parse_path`,
+  `parse_submit_body`, `_query_int`, `_validate_matches`, and the
+  `pack_batch`/`pack_epoch` bounds checks — which also clear the
+  taint of the argument NAMES they validate in place).
+
+The three rule families on top:
+
+- `unbucketed-shape-at-jit-boundary` — a dynamic-shaped ARRAY reaches
+  a `jax.jit`/`shard_map`-wrapped call site without passing through a
+  bucketing op. This is the ROADMAP's standing "every new kernel must
+  be born shape-bucketed" constraint as a statically checked contract.
+- `dtype-drift-into-kernel` — a 64-bit-producing op (bare
+  `np.arange`/`np.argsort`/`np.zeros`, `json.loads` numerics) flows
+  into a jitted kernel argument; the snapshot wire format pins
+  int32/float32, so 64-bit inputs either silently downcast (x32) or
+  poison the cache with second dtypes (x64).
+- `unvalidated-wire-input` — tainted request data reaches an
+  engine/front-door mutation call (`submit`, `admit`, `update`,
+  `ingest`, `ingest_async`, `add`, `adopt_state`, `resubmit_spilled`)
+  with no sanitizer on SOME path (branch envs are joined, so a
+  sanitizer on one arm of an `if` does not launder the other arm).
+
+Like every jaxlint rule: heuristic, not sound — tuned so the clean
+tree lints clean and each family fires on its badcorpus example.
+Control flow is handled by joining branch environments (if/try arms)
+and running loop bodies twice; unknown calls propagate taint but make
+no shape/dtype claim, which keeps false positives down at the cost of
+missing exotic flows. No jax imports anywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from arena.analysis.jaxlint import rule
+from arena.analysis.project import dotted
+
+# --- the abstract value lattice --------------------------------------------
+
+# Shape provenance tags, in join rank order. BOTTOM = no information;
+# DYNAMIC = derived from a raw input length. Two distinct same-rank
+# elements (constant(2) vs constant(4), constant vs padded) join UP to
+# BUCKETED: "one of finitely many static shapes" — still compile-safe,
+# no longer a single known size.
+S_BOTTOM, S_STATIC, S_BUCKETED, S_DYNAMIC = 0, 1, 2, 3
+
+
+@dataclasses.dataclass(frozen=True)
+class Shape:
+    """One shape-lattice element: (rank, tag, payload)."""
+
+    rank: int
+    tag: str  # "bottom" | "constant" | "padded" | "bucketed" | "dynamic"
+    size: object = None  # int payload for constant/padded, else None
+
+
+SHAPE_BOTTOM = Shape(S_BOTTOM, "bottom")
+SHAPE_BUCKETED = Shape(S_BUCKETED, "bucketed")
+SHAPE_DYNAMIC = Shape(S_DYNAMIC, "dynamic")
+
+
+def shape_constant(k):
+    return Shape(S_STATIC, "constant", k)
+
+
+def shape_padded(b=None):
+    return Shape(S_STATIC, "padded", b)
+
+
+def join_shape(a: Shape, b: Shape) -> Shape:
+    """Least upper bound. Commutative, idempotent, associative —
+    property-tested over randomized elements (and mutation-audited:
+    a join that collapses to bottom silently blinds every rule that
+    rides the lattice)."""
+    if a.rank < b.rank:
+        return b
+    if b.rank < a.rank:
+        return a
+    if a == b:
+        return a
+    # Same rank, different elements: the only multi-element rank is
+    # S_STATIC (constant(k)/padded(b)); their lub is "finite shape
+    # set" — bucketed.
+    return SHAPE_BUCKETED
+
+
+# 64-bit dtypes the kernel rule flags. "py64" marks Python numbers
+# (json.loads output, float()/int() chains) that np.asarray widens to
+# a 64-bit array when no explicit dtype pins them.
+WIDE_DTYPES = frozenset({"int64", "float64", "py64"})
+
+_DTYPE_TAILS = frozenset({
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bfloat16", "bool_",
+})
+
+
+# Dtype/kind are flat lattices: None is BOTTOM (no information — the
+# identity, so a known dtype survives joining with an untracked
+# value), "mixed" is TOP (two different concrete claims — no single
+# claim survives, and "mixed" is never in WIDE_DTYPES so the boundary
+# rule stays quiet on it).
+MIXED = "mixed"
+
+
+def join_dtype(a, b):
+    if a is None or a == b:
+        return b
+    if b is None:
+        return a
+    return MIXED
+
+
+def join_kind(a, b):
+    if a is None or a == b:
+        return b
+    if b is None:
+        return a
+    return MIXED
+
+
+@dataclasses.dataclass(frozen=True)
+class AbsValue:
+    """One abstract value: shape provenance x dtype x kind x taint."""
+
+    shape: Shape = SHAPE_BOTTOM
+    dtype: object = None  # str | None
+    kind: object = None  # "scalar" | "array" | None
+    tainted: bool = False
+
+
+BOTTOM = AbsValue()
+TAINTED = AbsValue(tainted=True)
+
+
+def join(a: AbsValue, b: AbsValue) -> AbsValue:
+    return AbsValue(
+        shape=join_shape(a.shape, b.shape),
+        dtype=join_dtype(a.dtype, b.dtype),
+        kind=join_kind(a.kind, b.kind),
+        tainted=a.tainted or b.tainted,
+    )
+
+
+def join_all(values):
+    out = BOTTOM
+    for v in values:
+        out = join(out, v)
+    return out
+
+
+# --- recognized operation sets ---------------------------------------------
+
+# Bucketing ops: calls whose RESULT is shape-safe by contract (the
+# pow2 bucket contract, the chunked epoch layout, the reusable staging
+# slots, an explicit pad-to-constant). The shape rule treats their
+# results as `bucketed`; an emptied set here is the
+# "bucketing-op-not-recognized" mutant — every real pack_batch /
+# chunk_layout call site would read dynamic and the clean-tree gate
+# goes red.
+BUCKETING_TAILS = frozenset({
+    "bucket_size", "next_pow2", "_pow2_ceil", "pack_batch", "pack_epoch",
+    "chunk_layout", "stage", "pad",
+})
+
+# Taint sources: the HTTP handler request fields. `rfile`/`headers`
+# attribute reads are sources anywhere (nothing else in the tree spells
+# them); `path`/`requestline`/`command` only inside classes whose bases
+# mention RequestHandler (a pathlib `.path` must not taint the world).
+WIRE_TAINT_ATTRS = frozenset({"rfile", "headers"})
+HANDLER_TAINT_ATTRS = frozenset({"path", "requestline", "command"})
+TAINT_SOURCE_TAILS = frozenset({"parse_qs", "parse_qsl"})
+
+# Sanitizers: the protocol validation helpers and the engine's ingest
+# bounds checks. A call clears the taint of its RESULT and of the
+# argument names it validated in place (`_validate_matches(n, w, l)`
+# leaves w/l checked). The "taint-sanitizer-check-skipped" mutant
+# empties this set: validated flows read tainted and the fixture
+# pinning `parse_submit_body` as a sanitizer goes red.
+TAINT_SANITIZER_TAILS = frozenset({
+    "parse_submit_body", "parse_path", "_query_int", "_validate_matches",
+    "pack_batch", "pack_epoch",
+})
+
+# Sinks: engine/front-door mutation calls. Generic-looking tails
+# (`update`, `add`) are safe here because a finding additionally
+# requires a TAINTED argument — taint only exists on wire-input flows.
+TAINT_SINK_TAILS = frozenset({
+    "submit", "admit", "update", "ingest", "ingest_async", "add",
+    "adopt_state", "resubmit_spilled",
+})
+
+# NumPy/jnp constructors and transforms the interpreter models.
+_NUMPY_ROOTS = frozenset({"np", "numpy", "jnp"})
+_INT64_PRODUCER_TAILS = frozenset({
+    "arange", "argsort", "searchsorted", "bincount", "nonzero", "argwhere",
+    "argmax", "argmin",
+})
+_FLOAT64_DEFAULT_TAILS = frozenset({"zeros", "ones", "empty"})
+_PROPAGATE_TAILS = frozenset({
+    "array", "asarray", "ascontiguousarray", "sort", "cumsum", "unique",
+    "where", "concatenate", "stack", "hstack", "vstack", "repeat", "split",
+})
+_LIKE_TAILS = frozenset({"zeros_like", "ones_like", "empty_like", "full_like"})
+
+RULE_UNBUCKETED = "unbucketed-shape-at-jit-boundary"
+RULE_DTYPE = "dtype-drift-into-kernel"
+RULE_TAINT = "unvalidated-wire-input"
+
+
+def _is_numpy_call(fname):
+    return fname is not None and "." in fname and fname.split(".")[0] in _NUMPY_ROOTS
+
+
+def _resolve_dtype(node, default=None):
+    """A dtype expression -> dtype name, or `default` when absent /
+    unresolvable (unresolvable means NO claim, never a 64-bit claim)."""
+    if node is None:
+        return default
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        name = node.value
+        return name if name in _DTYPE_TAILS else None
+    name = dotted(node)
+    if name is not None:
+        tail = name.split(".")[-1]
+        if tail in _DTYPE_TAILS:
+            return "bool" if tail == "bool_" else tail
+    return None
+
+
+def _kwargs(call):
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+# --- one function scope, interpreted forward -------------------------------
+
+
+class _ScopeAnalysis:
+    """Forward pass over one scope (function body or module level).
+
+    `interp` is the per-module _ModuleAnalysis (jit-boundary sets,
+    one-hop resolution, the shared finding sink); `depth` > 0 means
+    this scope is being evaluated as a ONE-HOP callee summary — no
+    further call expansion, and findings go to the summary's
+    collector instead of straight to the module's."""
+
+    def __init__(self, interp, scope_node, cls_node, depth, seed_env=None):
+        self.interp = interp
+        self.scope = scope_node
+        self.cls = cls_node
+        self.depth = depth
+        self.env = dict(seed_env or {})
+        self.returns = BOTTOM
+        self.findings = []  # (rule, node, message)
+
+    # -- statement walk ----------------------------------------------------
+
+    def run(self):
+        body = getattr(self.scope, "body", [])
+        self.exec_stmts(body, self.env)
+        return self
+
+    def exec_stmts(self, stmts, env):
+        for stmt in stmts:
+            self.exec_stmt(stmt, env)
+
+    def exec_stmt(self, stmt, env):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # nested scopes are analyzed on their own
+        if isinstance(stmt, ast.Assign):
+            val = self.eval(stmt.value, env)
+            for tgt in stmt.targets:
+                self.assign(tgt, val, stmt.value, env)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.assign(stmt.target, self.eval(stmt.value, env), stmt.value, env)
+        elif isinstance(stmt, ast.AugAssign):
+            val = self.eval(stmt.value, env)
+            name = dotted(stmt.target)
+            if name is not None:
+                env[name] = join(env.get(name, BOTTOM), val)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.returns = join(self.returns, self.eval(stmt.value, env))
+        elif isinstance(stmt, ast.Expr):
+            self.eval(stmt.value, env)
+        elif isinstance(stmt, ast.If):
+            self.eval(stmt.test, env)
+            then_env = dict(env)
+            self.exec_stmts(stmt.body, then_env)
+            else_env = dict(env)
+            self.exec_stmts(stmt.orelse, else_env)
+            self._merge(env, then_env, else_env)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            iter_val = self.eval(stmt.iter, env)
+            # The loop variable inherits the iterable's taint/dtype
+            # (an element of attacker data is attacker data).
+            elem = AbsValue(dtype=iter_val.dtype, tainted=iter_val.tainted)
+            for _pass in (0, 1):  # twice: loop-carried flows settle
+                self.assign(stmt.target, elem, stmt.iter, env)
+                body_env = dict(env)
+                self.exec_stmts(stmt.body, body_env)
+                self._merge(env, body_env, env)
+            self.exec_stmts(stmt.orelse, env)
+        elif isinstance(stmt, ast.While):
+            for _pass in (0, 1):
+                self.eval(stmt.test, env)
+                body_env = dict(env)
+                self.exec_stmts(stmt.body, body_env)
+                self._merge(env, body_env, env)
+            self.exec_stmts(stmt.orelse, env)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                ctx_val = self.eval(item.context_expr, env)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, ctx_val, item.context_expr, env)
+            self.exec_stmts(stmt.body, env)
+        elif isinstance(stmt, ast.Try):
+            body_env = dict(env)
+            self.exec_stmts(stmt.body, body_env)
+            arm_envs = [body_env]
+            for handler in stmt.handlers:
+                h_env = dict(env)
+                self.exec_stmts(handler.body, h_env)
+                arm_envs.append(h_env)
+            self._merge(env, *arm_envs)
+            self.exec_stmts(stmt.orelse, env)
+            self.exec_stmts(stmt.finalbody, env)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                name = dotted(tgt)
+                if name is not None:
+                    env.pop(name, None)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for field in ("exc", "cause", "test", "msg"):
+                sub = getattr(stmt, field, None)
+                if sub is not None:
+                    self.eval(sub, env)
+
+    def _merge(self, env, *arm_envs):
+        """Join arm environments back into `env` in place: a name is
+        as bad as its worst arm — which is what makes "sanitizer on
+        every path" a real check rather than a first-path accident."""
+        keys = set(env)
+        for arm in arm_envs:
+            keys |= set(arm)
+        for key in keys:
+            vals = [arm.get(key, env.get(key, BOTTOM)) for arm in arm_envs]
+            env[key] = join_all(vals)
+
+    def assign(self, target, value, value_node, env):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if isinstance(value_node, (ast.Tuple, ast.List)) and len(
+                value_node.elts
+            ) == len(elts):
+                for tgt, sub in zip(elts, value_node.elts):
+                    self.assign(tgt, self.eval(sub, env), sub, env)
+            else:
+                for tgt in elts:
+                    self.assign(tgt, value, value_node, env)
+            return
+        if isinstance(target, ast.Starred):
+            target = target.value
+        name = dotted(target)
+        if name is not None:
+            env[name] = value
+
+    # -- expression evaluation --------------------------------------------
+
+    def eval(self, node, env) -> AbsValue:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool) or node.value is None:
+                return BOTTOM
+            if isinstance(node.value, int):
+                return AbsValue(shape=shape_constant(node.value), kind="scalar")
+            return AbsValue(kind="scalar")
+        if isinstance(node, ast.Name):
+            return env.get(node.id, BOTTOM)
+        if isinstance(node, ast.Attribute):
+            return self.eval_attribute(node, env)
+        if isinstance(node, ast.Subscript):
+            return self.eval_subscript(node, env)
+        if isinstance(node, ast.Call):
+            return self.eval_call(node, env)
+        if isinstance(node, ast.BinOp):
+            return join(self.eval(node.left, env), self.eval(node.right, env))
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, ast.BoolOp):
+            return join_all(self.eval(v, env) for v in node.values)
+        if isinstance(node, ast.Compare):
+            vals = [self.eval(node.left, env)]
+            vals += [self.eval(c, env) for c in node.comparators]
+            # A comparison result is a bool; only taint survives.
+            return AbsValue(kind="scalar", tainted=any(v.tainted for v in vals))
+        if isinstance(node, ast.IfExp):
+            self.eval(node.test, env)
+            return join(self.eval(node.body, env), self.eval(node.orelse, env))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return join_all(self.eval(e, env) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return join_all(
+                self.eval(v, env) for v in node.values if v is not None
+            )
+        if isinstance(node, ast.JoinedStr):
+            parts = [
+                self.eval(v.value, env)
+                for v in node.values
+                if isinstance(v, ast.FormattedValue)
+            ]
+            return AbsValue(kind="scalar", tainted=any(p.tainted for p in parts))
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            tainted = False
+            for gen in node.generators:
+                tainted = tainted or self.eval(gen.iter, env).tainted
+            return AbsValue(tainted=tainted)
+        if isinstance(node, ast.Slice):
+            return join_all(
+                self.eval(s, env)
+                for s in (node.lower, node.upper, node.step)
+                if s is not None
+            )
+        return BOTTOM
+
+    def _shape_provenance(self, base_val: AbsValue) -> Shape:
+        """The provenance of a size READ off a value: a known shape is
+        its own provenance; reading the length of an UNTRACKED value
+        is the rule's dynamic source (`len(matches)` off raw ingest)."""
+        if base_val.shape.rank > S_BOTTOM:
+            return base_val.shape
+        return SHAPE_DYNAMIC
+
+    def eval_attribute(self, node, env) -> AbsValue:
+        name = dotted(node)
+        if name is not None and name in env:
+            return env[name]
+        base = self.eval(node.value, env)
+        attr = node.attr
+        if attr in WIRE_TAINT_ATTRS:
+            return TAINTED
+        if attr in HANDLER_TAINT_ATTRS and self._in_handler_class(node):
+            return TAINTED
+        if attr in ("shape", "size", "nbytes"):
+            return AbsValue(
+                shape=self._shape_provenance(base),
+                kind="scalar",
+                tainted=base.tainted,
+            )
+        # A field of a tracked value (packed.winners off a bucketed
+        # PackedBatch) carries the container's provenance.
+        return AbsValue(
+            shape=base.shape, dtype=base.dtype, tainted=base.tainted
+        )
+
+    def _in_handler_class(self, node):
+        if self.cls is None:
+            return False
+        if not (isinstance(node.value, ast.Name) and node.value.id == "self"):
+            return False
+        for base in self.cls.bases:
+            base_name = dotted(base) or ""
+            if "RequestHandler" in base_name:
+                return True
+        return False
+
+    def eval_subscript(self, node, env) -> AbsValue:
+        base = self.eval(node.value, env)
+        idx = node.slice
+        if isinstance(idx, ast.Slice):
+            bound = join_all(
+                self.eval(s, env)
+                for s in (idx.lower, idx.upper, idx.step)
+                if s is not None
+            )
+            shape = base.shape
+            if bound.shape == SHAPE_DYNAMIC:
+                shape = SHAPE_DYNAMIC  # x[:n] with a raw-length n
+            return AbsValue(
+                shape=shape, dtype=base.dtype, kind="array",
+                tainted=base.tainted or bound.tainted,
+            )
+        self.eval(idx, env)
+        # Single-element access: provenance and taint ride along
+        # (doc["winners"] of a tainted doc is tainted; x.shape[0]
+        # keeps the shape provenance the attribute read established,
+        # and stays a SCALAR — the v1 nonstatic-shape-arg rule owns
+        # scalar shape args, the v3 rule owns arrays).
+        return AbsValue(
+            shape=base.shape, dtype=base.dtype,
+            kind="scalar" if base.kind == "scalar" else None,
+            tainted=base.tainted,
+        )
+
+    # -- calls --------------------------------------------------------------
+
+    def eval_call(self, call, env) -> AbsValue:
+        arg_vals = [self.eval(a, env) for a in call.args]
+        kw_nodes = _kwargs(call)
+        kw_vals = {k: self.eval(v, env) for k, v in kw_nodes.items()}
+        all_vals = arg_vals + list(kw_vals.values())
+        fname = dotted(call.func)
+        tail = fname.split(".")[-1] if fname else None
+        receiver = (
+            self.eval(call.func.value, env)
+            if isinstance(call.func, ast.Attribute)
+            else BOTTOM
+        )
+
+        # Sinks first: a tainted argument reaching a mutation call is
+        # the unvalidated-wire-input finding, whatever else the call is.
+        if tail in TAINT_SINK_TAILS:
+            for arg_node, arg_val in zip(call.args, arg_vals):
+                if arg_val.tainted:
+                    self.report(
+                        RULE_TAINT,
+                        arg_node,
+                        f"untrusted wire input reaches mutation call "
+                        f"`{fname}` without passing a protocol validator "
+                        "(parse_submit_body / parse_path / the pack_batch "
+                        "bounds checks) on every path — validate before "
+                        "mutating engine state",
+                    )
+            for k, v in kw_vals.items():
+                if v.tainted:
+                    self.report(
+                        RULE_TAINT,
+                        kw_nodes[k],
+                        f"untrusted wire input reaches mutation call "
+                        f"`{fname}` (kwarg `{k}`) without a sanitizer on "
+                        "every path — validate before mutating engine state",
+                    )
+
+        # Jit boundaries: the shape and dtype contracts are checked on
+        # every argument crossing into compiled code.
+        if fname is not None and self.interp.is_jit_boundary(fname):
+            self._check_boundary(call, fname, arg_vals, kw_nodes, kw_vals)
+            return AbsValue(
+                kind="array", tainted=any(v.tainted for v in all_vals)
+            )
+
+        if tail in TAINT_SANITIZER_TAILS:
+            # Validation-in-place: the argument NAMES the sanitizer saw
+            # are clean from here on (engine.ingest validates w/l then
+            # hands the same arrays to the store).
+            for arg_node in list(call.args) + list(kw_nodes.values()):
+                arg_name = dotted(arg_node)
+                if arg_name is not None and arg_name in env:
+                    prev = env[arg_name]
+                    if prev.tainted:
+                        env[arg_name] = dataclasses.replace(prev, tainted=False)
+            shape = SHAPE_BUCKETED if tail in BUCKETING_TAILS else SHAPE_BOTTOM
+            return AbsValue(shape=shape, kind="array" if shape.rank else None)
+
+        if tail in BUCKETING_TAILS:
+            return AbsValue(
+                shape=SHAPE_BUCKETED,
+                kind="scalar" if tail in ("bucket_size", "next_pow2", "_pow2_ceil")
+                else "array",
+            )
+
+        if tail in TAINT_SOURCE_TAILS:
+            return TAINTED
+
+        if tail == "loads" and fname in ("json.loads", "loads"):
+            # Wire JSON: numbers decode as Python int/float — 64-bit
+            # the moment an unpinned np.asarray touches them. Taint is
+            # the INPUT's: json.loads of a trusted file stays clean.
+            return AbsValue(
+                dtype="py64", tainted=any(v.tainted for v in all_vals)
+            )
+
+        if fname == "len" and len(arg_vals) == 1:
+            return AbsValue(
+                shape=self._shape_provenance(arg_vals[0]),
+                kind="scalar",
+                tainted=arg_vals[0].tainted,
+            )
+
+        if fname in ("int", "float", "bool", "str", "abs", "min", "max", "sum"):
+            joined = join_all(arg_vals)
+            return AbsValue(
+                shape=joined.shape, kind="scalar", tainted=joined.tainted
+            )
+
+        if _is_numpy_call(fname):
+            out = self._eval_numpy(
+                fname.split(".")[0], tail, call, arg_vals, kw_nodes, kw_vals
+            )
+            if out is not None:
+                return out
+
+        if isinstance(call.func, ast.Attribute):
+            out = self._eval_method(call, receiver, arg_vals, kw_nodes)
+            if out is not None:
+                return out
+
+        # One-hop interprocedural: a callee the project table resolves
+        # is summarized with the call site's abstract arguments.
+        if self.depth == 0 and fname is not None:
+            out = self.interp.expand_call(self, call, fname, arg_vals, kw_vals)
+            if out is not None:
+                return out
+
+        # Unknown call: taint flows through, and so does SHAPE
+        # provenance (join of the arguments') — a helper the table
+        # cannot resolve is assumed to hand back what it was fed.
+        # This is what makes the recognized bucketing ops load-
+        # bearing: they are the only calls that launder a dynamic
+        # size back to a safe shape, so dropping one from the
+        # recognized set turns its real call sites into findings
+        # (the "bucketing-op-not-recognized" mutant's kill path).
+        joined = join_all(all_vals)
+        return AbsValue(
+            shape=joined.shape,
+            tainted=receiver.tainted or joined.tainted,
+        )
+
+    def _eval_numpy(self, root, tail, call, arg_vals, kw_nodes, kw_vals):
+        # The 64-bit DEFAULT claims apply to host NumPy only: under the
+        # repo's x32 JAX config the jnp constructors default to 32-bit,
+        # so a bare `jnp.zeros(n)` is not a drift producer.
+        host_np = root in ("np", "numpy")
+        args = call.args
+        if tail in _FLOAT64_DEFAULT_TAILS:
+            dt_node = kw_nodes.get("dtype") or (args[1] if len(args) > 1 else None)
+            dtype = _resolve_dtype(dt_node, default="float64" if host_np else None)
+            shape = arg_vals[0].shape if arg_vals else SHAPE_BOTTOM
+            return AbsValue(shape=shape, dtype=dtype, kind="array")
+        if tail == "full":
+            dt_node = kw_nodes.get("dtype") or (args[2] if len(args) > 2 else None)
+            default = None
+            if (
+                host_np
+                and dt_node is None
+                and len(args) > 1
+                and isinstance(args[1], ast.Constant)
+            ):
+                if isinstance(args[1].value, float):
+                    default = "float64"
+                elif isinstance(args[1].value, int):
+                    default = "int64"
+            dtype = _resolve_dtype(dt_node, default=default)
+            shape = arg_vals[0].shape if arg_vals else SHAPE_BOTTOM
+            return AbsValue(shape=shape, dtype=dtype, kind="array")
+        if tail in _INT64_PRODUCER_TAILS:
+            dt_node = kw_nodes.get("dtype")
+            if dt_node is None and tail == "arange":
+                has_float = any(
+                    isinstance(a, ast.Constant) and isinstance(a.value, float)
+                    for a in args
+                )
+                dtype = (
+                    "float64" if has_float else "int64"
+                ) if host_np else None
+            else:
+                dtype = _resolve_dtype(
+                    dt_node, default="int64" if host_np else None
+                )
+            if tail == "arange":
+                shape = SHAPE_BOTTOM
+                for v in arg_vals:
+                    shape = join_shape(shape, v.shape)
+            else:
+                shape = arg_vals[0].shape if arg_vals else SHAPE_BOTTOM
+            return AbsValue(
+                shape=shape, dtype=dtype, kind="array",
+                tainted=any(v.tainted for v in arg_vals),
+            )
+        if tail in _LIKE_TAILS:
+            base = arg_vals[0] if arg_vals else BOTTOM
+            dt_node = kw_nodes.get("dtype")
+            dtype = _resolve_dtype(dt_node, default=base.dtype)
+            return AbsValue(
+                shape=base.shape, dtype=dtype, kind="array", tainted=base.tainted
+            )
+        if tail in _PROPAGATE_TAILS:
+            base = join_all(arg_vals) if arg_vals else BOTTOM
+            dt_node = kw_nodes.get("dtype") or (
+                args[1] if tail in ("array", "asarray") and len(args) > 1 else None
+            )
+            dtype = _resolve_dtype(dt_node, default=base.dtype)
+            return AbsValue(
+                shape=base.shape, dtype=dtype, kind="array", tainted=base.tainted
+            )
+        return None
+
+    def _eval_method(self, call, receiver, arg_vals, kw_nodes):
+        meth = call.func.attr
+        if meth == "astype":
+            dt_node = kw_nodes.get("dtype") or (call.args[0] if call.args else None)
+            dtype = _resolve_dtype(dt_node)
+            return AbsValue(
+                shape=receiver.shape, dtype=dtype, kind="array",
+                tainted=receiver.tainted,
+            )
+        if meth in ("copy", "ravel", "flatten", "tolist", "view"):
+            return dataclasses.replace(receiver)
+        if meth == "reshape":
+            shape = receiver.shape
+            for v in arg_vals:
+                shape = join_shape(shape, v.shape)
+            return AbsValue(
+                shape=shape, dtype=receiver.dtype, kind="array",
+                tainted=receiver.tainted,
+            )
+        if meth in ("get", "pop", "item", "read", "decode", "encode", "strip",
+                    "split", "lower", "upper", "json"):
+            tainted = receiver.tainted or any(v.tainted for v in arg_vals)
+            return AbsValue(
+                dtype=receiver.dtype if meth in ("get", "pop") else None,
+                tainted=tainted,
+            )
+        return None
+
+    def _check_boundary(self, call, fname, arg_vals, kw_nodes, kw_vals):
+        items = list(zip(call.args, arg_vals)) + [
+            (kw_nodes[k], v) for k, v in kw_vals.items()
+        ]
+        # `kind != "scalar"`: a KNOWN scalar shape arg is v1's
+        # nonstatic-shape-arg territory; everything else (arrays, and
+        # values a branch join blurred) belongs to the v3 contracts.
+        for node, val in items:
+            if val.shape == SHAPE_DYNAMIC and val.kind != "scalar":
+                self.report(
+                    RULE_UNBUCKETED,
+                    node,
+                    f"array shaped by a raw input length reaches jitted "
+                    f"`{fname}` without a bucketing op (bucket_size / "
+                    "pack_batch / pack_epoch / chunk_layout / pad-to-"
+                    "constant) — every distinct size compiles a new "
+                    "executable, breaking the recompile_events == 0 gate",
+                )
+            if val.dtype in WIDE_DTYPES and val.kind != "scalar":
+                origin = (
+                    "json-decoded Python numbers"
+                    if val.dtype == "py64"
+                    else f"a {val.dtype}-producing op"
+                )
+                self.report(
+                    RULE_DTYPE,
+                    node,
+                    f"{origin} flow into jitted `{fname}` — the kernel "
+                    "contract pins int32/float32 (the snapshot wire "
+                    "format); pass an explicit 32-bit dtype at the "
+                    "producer or .astype(...) before the boundary",
+                )
+
+    def report(self, rule_name, node, message):
+        self.findings.append((rule_name, node, message))
+
+
+# --- per-module driver ------------------------------------------------------
+
+
+class _ModuleAnalysis:
+    """One abstract-interpretation pass per module, shared by the
+    three v3 rules (computed once, cached on the ModuleContext)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.findings = {RULE_UNBUCKETED: [], RULE_DTYPE: [], RULE_TAINT: []}
+        self._boundary_names = self._collect_boundaries(ctx)
+        self._summary_cache = {}
+        self._baseline_cache = {}
+        self._stack = []
+
+    @staticmethod
+    def _collect_boundaries(ctx):
+        names = set(ctx.jitted_callables)
+        for fn in ctx.traced_defs:
+            names.add(fn.name)
+        return names
+
+    def is_jit_boundary(self, fname):
+        if fname in self._boundary_names:
+            return True
+        tail = fname.split(".")[-1]
+        return tail in self._boundary_names and fname.startswith("self.")
+
+    # -- scope enumeration --------------------------------------------------
+
+    def run(self):
+        ctx = self.ctx
+        module_scope = _ScopeAnalysis(self, ctx.tree, None, depth=0)
+        module_scope.run()
+        self._drain(module_scope, ctx)
+        for fn_node, cls_node in self._iter_functions(ctx.tree):
+            if ctx.is_traced_def(fn_node):
+                continue  # inside compiled code the contracts differ
+            scope = _ScopeAnalysis(self, fn_node, cls_node, depth=0)
+            scope.run()
+            self._drain(scope, ctx)
+        return self
+
+    @staticmethod
+    def _iter_functions(tree):
+        def walk(node, cls):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield child, cls
+                    yield from walk(child, cls)
+                elif isinstance(child, ast.ClassDef):
+                    yield from walk(child, child)
+                else:
+                    yield from walk(child, cls)
+
+        yield from walk(tree, None)
+
+    def _drain(self, scope, ctx):
+        seen = set()
+        for rule_name, node, message in scope.findings:
+            key = (rule_name, node.lineno, node.col_offset, message)
+            if key in seen:
+                continue
+            seen.add(key)
+            self.findings[rule_name].append(ctx.finding(node, rule_name, message))
+
+    # -- one-hop call expansion --------------------------------------------
+
+    def _resolve_callee(self, caller_scope, fname):
+        """(def node, class node, home ModuleContext, qualname) for a
+        callee the table resolves, else None. Same one-hop surface as
+        the lock analyzer: same-module functions, same-class methods,
+        `from x import f` imports."""
+        ctx = self.ctx
+        parts = fname.split(".")
+        if parts[0] == "self" and len(parts) == 2 and caller_scope.cls is not None:
+            for item in caller_scope.cls.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if item.name == parts[1]:
+                        return item, caller_scope.cls, ctx, (
+                            f"{caller_scope.cls.name}.{parts[1]}"
+                        )
+            return None
+        if len(parts) == 1 and fname in ctx.symbols.functions:
+            return ctx.symbols.functions[fname], None, ctx, fname
+        # Imported: longest dotted prefix bound by an import.
+        siblings = getattr(ctx, "siblings", None)
+        if not siblings or ctx.project is None:
+            return None
+        for i in range(len(parts), 0, -1):
+            head = ".".join(parts[:i])
+            if head not in ctx.symbols.imports:
+                continue
+            src_name, symbol = ctx.symbols.imports[head]
+            rest = parts[i:]
+            if symbol is not None:
+                rest = [symbol] + rest
+            src = ctx.project.module(src_name)
+            if src is None and rest:
+                src = ctx.project.module(f"{src_name}.{rest[0]}")
+                rest = rest[1:]
+            if src is None:
+                continue
+            home = siblings.get(src.name)
+            if home is None:
+                continue
+            if len(rest) == 1 and rest[0] in src.functions:
+                return src.functions[rest[0]], None, home, rest[0]
+        return None
+
+    def expand_call(self, caller_scope, call, fname, arg_vals, kw_vals):
+        resolved = self._resolve_callee(caller_scope, fname)
+        if resolved is None:
+            return None
+        fn_node, cls_node, home, qualname = resolved
+        key = (home.path, qualname)
+        if key in self._stack:
+            return None  # recursion: no claim
+        interesting = any(
+            v.tainted or v.shape == SHAPE_DYNAMIC or v.dtype in WIDE_DTYPES
+            for v in list(arg_vals) + list(kw_vals.values())
+        )
+        if not interesting:
+            base = self._baseline(fn_node, cls_node, home, key)
+            return base.returns
+        seed = self._seed_env(fn_node, call, arg_vals, kw_vals)
+        self._stack.append(key)
+        try:
+            home_interp = self if home is self.ctx else _ModuleAnalysis(home)
+            scope = _ScopeAnalysis(
+                home_interp, fn_node, cls_node, depth=1, seed_env=seed
+            )
+            scope.run()
+        finally:
+            self._stack.pop()
+        baseline = self._baseline(fn_node, cls_node, home, key)
+        base_keys = {
+            (r, n.lineno, n.col_offset) for r, n, _m in baseline.findings
+        }
+        for rule_name, node, message in scope.findings:
+            if (rule_name, node.lineno, node.col_offset) in base_keys:
+                continue  # the callee's own problem, reported at home
+            caller_scope.report(
+                rule_name,
+                call,
+                f"{message} (flows one call deep into `{qualname}`, "
+                f"line {node.lineno})",
+            )
+        return scope.returns
+
+    def _baseline(self, fn_node, cls_node, home, key):
+        cached = self._baseline_cache.get(key)
+        if cached is None:
+            home_interp = self if home is self.ctx else _ModuleAnalysis(home)
+            cached = _ScopeAnalysis(home_interp, fn_node, cls_node, depth=1).run()
+            self._baseline_cache[key] = cached
+        return cached
+
+    @staticmethod
+    def _seed_env(fn_node, call, arg_vals, kw_vals):
+        args = fn_node.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        seed = {}
+        offset = 1 if params and params[0] == "self" else 0
+        for name, val in zip(params[offset:], arg_vals):
+            seed[name] = val
+        for name, val in kw_vals.items():
+            if name in params or any(a.arg == name for a in args.kwonlyargs):
+                seed[name] = val
+        return seed
+
+
+def _analysis(ctx):
+    cached = getattr(ctx, "_absint_findings", None)
+    if cached is None:
+        cached = _ModuleAnalysis(ctx).run().findings
+        ctx._absint_findings = cached
+    return cached
+
+
+# --- the three v3 rules -----------------------------------------------------
+
+
+@rule(
+    RULE_UNBUCKETED,
+    "an array shaped by a raw input length (len(x), x.shape[0]) reaches a "
+    "jit/shard_map boundary without a recognized bucketing op — the "
+    "compile-free steady state as a statically checked contract",
+    severity="error",
+)
+def _check_unbucketed_shape(ctx):
+    yield from _analysis(ctx)[RULE_UNBUCKETED]
+
+
+@rule(
+    RULE_DTYPE,
+    "a 64-bit-producing op (bare np.arange/np.zeros, json.loads numerics) "
+    "flows into a jitted kernel argument pinned int32/float32 by the "
+    "snapshot wire format",
+    severity="warning",
+)
+def _check_dtype_drift(ctx):
+    yield from _analysis(ctx)[RULE_DTYPE]
+
+
+@rule(
+    RULE_TAINT,
+    "untrusted wire input (request body/headers/query) reaches an engine "
+    "or front-door mutation call with no protocol validator on every path",
+    severity="error",
+)
+def _check_wire_taint(ctx):
+    yield from _analysis(ctx)[RULE_TAINT]

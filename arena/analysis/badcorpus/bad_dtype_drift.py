@@ -1,0 +1,30 @@
+"""jaxlint corpus: 64-bit producers leaking into a pinned kernel.
+
+The snapshot wire format pins every kernel input to int32/float32
+(`arrays.bin` stores raw int32/float32; `pack_batch` coerces at
+ingest). A bare `np.arange` mints int64, and numbers out of
+`json.loads` are Python ints/floats that `np.asarray` widens to
+64-bit — either silently downcast at the jit boundary (x32) or
+poison the compile cache with second-dtype executables (x64).
+Rule: dtype-drift-into-kernel."""
+
+import json
+
+import jax
+import numpy as np
+
+kernel = jax.jit(lambda idx, w: w[idx].sum())
+
+
+def refit(num_players, weights):
+    """Bare np.arange defaults to int64 — the wire format says int32."""
+    idx = np.arange(num_players)
+    return kernel(idx, weights)
+
+
+def load_scores(text):
+    """json numerics -> np.asarray with no dtype: a float64 array
+    reaches the kernel argument the snapshot pins float32."""
+    doc = json.loads(text)
+    scores = np.asarray(doc["scores"])
+    return kernel(np.arange(4, dtype=np.int32), scores)

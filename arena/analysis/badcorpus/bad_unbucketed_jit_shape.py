@@ -1,0 +1,29 @@
+"""jaxlint corpus: a raw-input-length shape crossing the jit boundary.
+
+`len(matches)` / `weights.shape[0]` vary with every ingested batch;
+an array born with that size and handed to a jitted kernel compiles a
+NEW executable per distinct size — the exact recompile class the pow2
+bucket contract (engine.bucket_size / pack_batch / pack_epoch /
+chunk_layout) exists to cap, and the one the soak gate's
+`recompile_events == 0` would only catch after the fact at runtime.
+Rule: unbucketed-shape-at-jit-boundary."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+score = jax.jit(lambda x: x.sum())
+
+
+def ingest(matches):
+    """Every batch size mints a fresh executable: `deltas` is shaped
+    by the raw match count, never routed through a bucketing op."""
+    n = len(matches)
+    deltas = np.zeros(n, np.float32)
+    return score(jnp.asarray(deltas))
+
+
+def rescale(weights):
+    """Same hazard spelled through `.shape[0]` off an ingest array."""
+    padded = np.zeros(weights.shape[0], np.float32)
+    return score(jnp.asarray(padded))

@@ -1,0 +1,26 @@
+"""jaxlint corpus: request bytes mutating engine state unvalidated.
+
+The wire tier's contract (arena/net/protocol.py) is that every submit
+body passes `parse_submit_body` — JSON shape, integer lists, producer
+string — before anything touches the engine, and the engine's own
+`_validate_matches`/`pack_batch` bounds checks reject out-of-range
+ids at admission. This handler skips all of it: bytes off the socket
+(`self.rfile`) go through `json.loads` straight into `engine.update`,
+so a malformed or hostile body reaches the mutation path with no
+validator on any path. Rule: unvalidated-wire-input."""
+
+import json
+from http.server import BaseHTTPRequestHandler
+
+
+class RawIngestHandler(BaseHTTPRequestHandler):
+    """POST /submit, minus every check the front door exists for."""
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length)
+        doc = json.loads(raw)
+        engine = self.server.engine
+        engine.update(doc["winners"], doc["losers"])
+        self.send_response(202)
+        self.end_headers()

@@ -80,6 +80,7 @@ def _iter_scopes(symbols):
     "assignment to a `# guarded_by: <lock>`-annotated attribute outside a "
     "`with self.<lock>:` block (or a *_locked method) in a thread-shared "
     "class — a data race on declared-guarded state",
+    severity="error",
 )
 def _check_unguarded_shared_write(ctx):
     for cls in ctx.symbols.classes.values():
@@ -146,6 +147,7 @@ def _blocking_reason(call: ast.Call):
     "a blocking call (time.sleep / .join() / queue get-put with block=True "
     "/ block_until_ready) inside a held-lock region — every thread needing "
     "the lock stalls for the full wait",
+    severity="warning",
 )
 def _check_blocking_while_locked(ctx):
     symbols = ctx.symbols
@@ -174,6 +176,7 @@ def _check_blocking_while_locked(ctx):
     "two locks are acquired in opposite nesting orders somewhere across "
     "the project (lexical nesting + one-hop call-through edges from the "
     "cross-module lock-order graph) — a deadlock waiting for load",
+    severity="error",
 )
 def _check_lock_order_inversion(ctx):
     table = ctx.project
@@ -249,6 +252,7 @@ def _rechecks_liveness(while_node, cls):
     "a blocking wait loop in a thread-spawning class never re-checks "
     "worker liveness (.is_alive) — if the worker died, the caller hangs "
     "forever instead of raising",
+    severity="error",
 )
 def _check_thread_no_liveness_recheck(ctx):
     for cls in ctx.symbols.classes.values():

@@ -104,19 +104,43 @@ class Rule:
     name: str
     summary: str
     check: object  # ModuleContext -> iterable of Finding
+    severity: str  # "error" | "warning" — every rule must declare one
 
 
 RULES: dict[str, Rule] = {}
 
+# The severity vocabulary is closed: a rule must declare one of these
+# at registration (no default — the selfcheck pins that every rule in
+# the registry declares one, so severity can never silently drift as
+# the registry grows) and --format=json carries it per finding.
+SEVERITIES = ("error", "warning")
 
-def rule(name, summary):
+# Findings synthesized outside the registry (unparseable file) are
+# errors by definition.
+_SYNTHETIC_SEVERITY = "error"
+
+
+def rule(name, summary, *, severity):
+    if severity not in SEVERITIES:
+        raise ValueError(
+            f"rule {name!r} declares severity {severity!r}; must be one of "
+            f"{SEVERITIES}"
+        )
+
     def register(fn):
         if name in RULES:
             raise ValueError(f"duplicate rule name {name!r}")
-        RULES[name] = Rule(name, summary, fn)
+        RULES[name] = Rule(name, summary, fn, severity)
         return fn
 
     return register
+
+
+def finding_severity(finding) -> str:
+    """The declared severity of a finding's rule (synthetic rules like
+    syntax-error report as errors)."""
+    r = RULES.get(finding.rule)
+    return r.severity if r is not None else _SYNTHETIC_SEVERITY
 
 
 # --- shared AST helpers ----------------------------------------------------
@@ -145,6 +169,7 @@ _FACTORY_TAILS = {
     "jit_elo_epoch": (True, (0,)),
     "jit_bt_fit": (True, ()),
     "jit_bt_fit_chunked": (True, ()),
+    "jit_elo_bootstrap": (True, ()),
 }
 _DONATION_GUARD_TAIL = "donation_guard"
 
@@ -395,6 +420,7 @@ def _local_names(fn_node) -> set[str]:
     "mutable-closure",
     "jit-traced function closes over mutable host state (list/dict/set); "
     "tracing captures it once — later mutations are invisible or unsound",
+    severity="error",
 )
 def _check_mutable_closure(ctx: ModuleContext):
     if not ctx.traced_defs:
@@ -443,6 +469,7 @@ _HOST_SYNC_METHOD_TAILS = ("item", "tolist")
     "host-sync-in-jit",
     "host-synchronizing call (float()/.item()/np.asarray/print) inside a "
     "jit-traced body — forces a device round-trip or fails under tracing",
+    severity="error",
 )
 def _check_host_sync(ctx: ModuleContext):
     for fn in ctx.traced_defs:
@@ -488,6 +515,7 @@ def _is_shapeish(expr, shape_locals) -> bool:
     "nonstatic-shape-arg",
     "shape-derived Python scalar flows into a jitted call that declares no "
     "static_argnums — a per-size recompile hazard (pow2 bucket contract)",
+    severity="warning",
 )
 def _check_nonstatic_shape_arg(ctx: ModuleContext):
     if not ctx.jitted_callables:
@@ -529,6 +557,7 @@ def _check_nonstatic_shape_arg(ctx: ModuleContext):
     "use-after-donate",
     "a buffer passed in a donated position is used after the donating "
     "call — on device it may alias freed or reused memory",
+    severity="error",
 )
 def _check_use_after_donate(ctx: ModuleContext):
     donating = {
@@ -638,6 +667,7 @@ _TIMING_CALLS = frozenset(
     "timing-without-block",
     "wall-clock measured across asynchronous JAX dispatch without "
     "block_until_ready — the timer stops before the device finishes",
+    severity="warning",
 )
 def _check_timing_without_block(ctx: ModuleContext):
     for scope in [
@@ -682,6 +712,7 @@ _HOST_COMPUTE_OPS = frozenset(
     "jnp-on-host-path",
     "device jnp compute op in a host-side NumPy ingest path — pays "
     "dispatch overhead and device round-trips where np is correct",
+    severity="warning",
 )
 def _check_jnp_on_host_path(ctx: ModuleContext):
     for scope in [
@@ -748,6 +779,7 @@ def _shard_map_site(call):
     "PartitionSpec names a mesh axis the site's mesh does not define — "
     "resolved CROSS-MODULE through the project symbol table, the silent "
     "class of mistake match_partition_rules only catches at runtime",
+    severity="error",
 )
 def _check_sharding_spec_arity(ctx: ModuleContext):
     tree = ctx.tree
@@ -854,13 +886,36 @@ def _check_sharding_spec_arity(ctx: ModuleContext):
 BADCORPUS_DIR = "badcorpus"
 
 
-def _apply_rules(ctx: ModuleContext, keep_suppressed: bool) -> list[Finding]:
-    """Pass 2 for one module: run every rule, then apply the
+class PathError(Exception):
+    """One or more lint targets were unusable (missing path, unreadable
+    file). Carries EVERY bad path seen in the run — the CLI reports
+    each on its own line and exits 2, instead of stopping at the first
+    (a CI run over a long target list should name every problem at
+    once)."""
+
+    def __init__(self, errors):
+        self.errors = list(errors)  # [(path, detail), ...]
+        super().__init__("; ".join(f"{p}: {d}" for p, d in self.errors))
+
+
+def _select_rules(rules):
+    """The registry slice a run executes: `rules=None` means all.
+    Unknown names raise ValueError (the CLI maps it to rc 2)."""
+    if rules is None:
+        return list(RULES.values())
+    unknown = sorted(set(rules) - set(RULES))
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(unknown)}")
+    return [RULES[name] for name in rules]
+
+
+def _apply_rules(ctx: ModuleContext, keep_suppressed: bool, selected=None) -> list[Finding]:
+    """Pass 2 for one module: run every selected rule, then apply the
     suppression table. keep_suppressed=True returns muted findings too,
     marked `suppressed=True` (the JSON format's contract); they never
     affect exit codes."""
     findings = []
-    for r in RULES.values():
+    for r in (selected if selected is not None else RULES.values()):
         findings.extend(r.check(ctx))
     kept = []
     for f in findings:
@@ -878,29 +933,36 @@ def _sorted_findings(findings):
 
 
 def lint_source(
-    source: str, path: str = "<string>", keep_suppressed: bool = False
+    source: str, path: str = "<string>", keep_suppressed: bool = False,
+    rules=None,
 ) -> list[Finding]:
     """Lint one module's source; returns findings after suppression.
     Single-module form: the project table holds just this module, so
     cross-module lookups fail softly (imported meshes stay unknown —
-    exactly the v1 behavior `lint_paths` upgrades on)."""
+    exactly the v1 behavior `lint_paths` upgrades on). `rules` selects
+    a registry subset by name (None = all)."""
+    selected = _select_rules(rules)
     try:
         ctx = ModuleContext(path, source)
     except SyntaxError as exc:
         return [Finding(path, exc.lineno or 0, exc.offset or 0, "syntax-error", str(exc))]
     ctx.project = project_mod.ProjectTable([ctx.symbols])
-    return _sorted_findings(_apply_rules(ctx, keep_suppressed))
+    ctx.siblings = {ctx.symbols.name: ctx}
+    return _sorted_findings(_apply_rules(ctx, keep_suppressed, selected))
 
 
-def iter_python_files(paths):
-    """Expand files/dirs into .py files. Directory walks skip the
-    embedded bad-example corpus (and __pycache__) unless the given root
-    itself points into the corpus — so `jaxlint arena/` is clean while
-    `jaxlint arena/analysis/badcorpus` lints the corpus."""
+def collect_python_files(paths):
+    """Expand files/dirs into `(files, errors)` — every bad path in the
+    target list is collected (with its reason), never just the first.
+    Directory walks skip the embedded bad-example corpus (and
+    __pycache__) unless the given root itself points into the corpus —
+    so `jaxlint arena/` is clean while `jaxlint arena/analysis/badcorpus`
+    lints the corpus."""
+    files, errors = [], []
     for raw in paths:
         p = pathlib.Path(raw)
         if p.is_file():
-            yield p
+            files.append(p)
         elif p.is_dir():
             inside_corpus = BADCORPUS_DIR in p.resolve().parts
             for f in sorted(p.rglob("*.py")):
@@ -909,30 +971,53 @@ def iter_python_files(paths):
                     continue
                 if not inside_corpus and BADCORPUS_DIR in rel_parts:
                     continue
-                yield f
+                files.append(f)
         else:
-            raise FileNotFoundError(f"no such file or directory: {raw}")
+            errors.append((raw, "no such file or directory"))
+    return files, errors
 
 
-def lint_paths(paths, keep_suppressed: bool = False) -> list[Finding]:
+def iter_python_files(paths):
+    """`collect_python_files` with the historical contract: raises
+    `PathError` (an all-bad-paths report) if anything was unusable."""
+    files, errors = collect_python_files(paths)
+    if errors:
+        raise PathError(errors)
+    return iter(files)
+
+
+def lint_paths(paths, keep_suppressed: bool = False, rules=None) -> list[Finding]:
     """The two-pass driver: pass 1 parses EVERY file and builds the
     project-wide symbol table; pass 2 runs the rules per module with
     that table in scope — so a rule looking at module B can resolve a
-    mesh or a lock defined in module A."""
+    mesh or a lock defined in module A. `rules` selects a registry
+    subset by name (None = all). Raises `PathError` carrying EVERY
+    missing/unreadable target after the whole walk."""
+    selected = _select_rules(rules)
     findings = []
     contexts = []
-    for f in iter_python_files(paths):
+    files, errors = collect_python_files(paths)
+    for f in files:
         try:
-            contexts.append(ModuleContext(str(f), f.read_text()))
+            source = f.read_text()
+        except OSError as exc:
+            errors.append((str(f), f"unreadable: {exc.strerror or exc}"))
+            continue
+        try:
+            contexts.append(ModuleContext(str(f), source))
         except SyntaxError as exc:
             findings.append(
                 Finding(str(f), exc.lineno or 0, exc.offset or 0,
                         "syntax-error", str(exc))
             )
+    if errors:
+        raise PathError(errors)
     table = project_mod.ProjectTable([ctx.symbols for ctx in contexts])
+    siblings = {ctx.symbols.name: ctx for ctx in contexts}
     for ctx in contexts:
         ctx.project = table
-        findings.extend(_apply_rules(ctx, keep_suppressed))
+        ctx.siblings = siblings
+        findings.extend(_apply_rules(ctx, keep_suppressed, selected))
     return _sorted_findings(findings)
 
 
@@ -945,7 +1030,9 @@ def default_targets() -> list[str]:
 def _json_line(finding: Finding) -> str:
     """One finding as one JSON object on one line — the mechanical
     consumption contract (CI, the perf watchdog): stable keys, no
-    nesting, suppressed findings included but flagged."""
+    nesting, suppressed findings included but flagged, and the rule's
+    declared `severity` carried per finding so a consumer can gate on
+    errors while only reporting warnings."""
     return json.dumps({
         "rule": finding.rule,
         "path": finding.path,
@@ -953,7 +1040,12 @@ def _json_line(finding: Finding) -> str:
         "col": finding.col,
         "message": finding.message,
         "suppressed": finding.suppressed,
+        "severity": finding_severity(finding),
     }, sort_keys=True)
+
+
+def _parse_rule_list(raw):
+    return [name.strip() for name in raw.split(",") if name.strip()]
 
 
 def main(argv=None) -> int:
@@ -967,22 +1059,65 @@ def main(argv=None) -> int:
         "bench.py, tests/)",
     )
     parser.add_argument(
-        "--list-rules", action="store_true", help="print the rule registry and exit"
+        "--list-rules", action="store_true",
+        help="print the rule registry (name, severity, one-line "
+        "semantics) and exit",
+    )
+    parser.add_argument(
+        "--rules", metavar="A,B",
+        help="run ONLY the named rules (comma-separated registry names) "
+        "— e.g. the expensive abstract-interp families in isolation. "
+        "Exit-code semantics unchanged.",
+    )
+    parser.add_argument(
+        "--disable", metavar="A,B",
+        help="skip the named rules (applied after --rules when both are "
+        "given). Exit-code semantics unchanged.",
     )
     parser.add_argument(
         "--format", choices=("human", "json"), default="human",
         help="human (default): path:line:col: rule: message; json: one "
         "JSON object per finding per line (suppressed findings included, "
-        "flagged). Exit codes are identical in both formats.",
+        "flagged; severity carried). Exit codes are identical in both "
+        "formats.",
     )
     args = parser.parse_args(argv)
     if args.list_rules:
         for r in RULES.values():
-            print(f"{r.name}: {r.summary}")
+            print(f"{r.name} [{r.severity}]: {r.summary}")
         return 0
+    selected = None
+    if args.rules is not None or args.disable is not None:
+        selected = (
+            _parse_rule_list(args.rules) if args.rules is not None
+            else list(RULES)
+        )
+        disabled = set(_parse_rule_list(args.disable or ""))
+        try:
+            _select_rules(selected)  # validate --rules names
+            _select_rules(sorted(disabled))  # validate --disable names
+        except ValueError as exc:
+            print(f"jaxlint: {exc}", file=sys.stderr)
+            return 2
+        selected = [name for name in selected if name not in disabled]
     targets = args.paths or default_targets()
     try:
-        findings = lint_paths(targets, keep_suppressed=(args.format == "json"))
+        findings = lint_paths(
+            targets, keep_suppressed=(args.format == "json"), rules=selected
+        )
+    except PathError as exc:
+        # EVERY bad path gets its own line (rc 2 covers them all): a
+        # long CI target list should not reveal its problems one
+        # rerun at a time.
+        for path, detail in exc.errors:
+            if args.format == "json":
+                print(json.dumps(
+                    {"error": "bad-path", "path": path, "message": detail},
+                    sort_keys=True,
+                ))
+            else:
+                print(f"jaxlint: {path}: {detail}", file=sys.stderr)
+        return 2
     except FileNotFoundError as exc:
         print(f"jaxlint: {exc}", file=sys.stderr)
         return 2
@@ -993,18 +1128,20 @@ def main(argv=None) -> int:
     else:
         for f in live:
             print(f.format())
+    n_rules = len(RULES) if selected is None else len(selected)
     print(
-        f"jaxlint: {len(live)} finding(s) over {len(RULES)} rule(s)",
+        f"jaxlint: {len(live)} finding(s) over {n_rules} rule(s)",
         file=sys.stderr,
     )
     return 1 if live else 0
 
 
-# Register the concurrency lock-discipline rules (they import this
-# module's registry, so the import sits at the bottom — by now every
-# name they need is defined; either import order ends with all rules
-# registered exactly once).
+# Register the concurrency lock-discipline rules and the v3 abstract-
+# interpretation rules (they import this module's registry, so the
+# imports sit at the bottom — by now every name they need is defined;
+# either import order ends with all rules registered exactly once).
 from arena.analysis import concurrency as _concurrency  # noqa: E402,F401
+from arena.analysis import absint as _absint  # noqa: E402,F401
 
 
 if __name__ == "__main__":

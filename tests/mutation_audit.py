@@ -533,6 +533,59 @@ MUTATIONS = (
         "bad_lock_order corpus contract)",
     ),
     (
+        "lattice-join-returns-bottom",
+        "arena/analysis/absint.py",
+        "    if a.rank < b.rank:\n"
+        "        return b\n"
+        "    if b.rank < a.rank:\n"
+        "        return a\n"
+        "    if a == b:\n"
+        "        return a",
+        "    if a.rank < b.rank:\n"
+        "        return SHAPE_BOTTOM\n"
+        "    if b.rank < a.rank:\n"
+        "        return SHAPE_BOTTOM\n"
+        "    if a == b:\n"
+        "        return SHAPE_BOTTOM",
+        "the abstract shape lattice's join is the substrate every v3 rule "
+        "rides: collapsed to bottom, a dynamic size joined across a branch "
+        "or a loop silently reads as 'no information' and the "
+        "unbucketed-shape rule goes blind while the linter still reports "
+        "success — killed by test_shape_join_commutative_idempotent "
+        "(join(x, x) == x fails for any non-bottom x)",
+    ),
+    (
+        "bucketing-op-not-recognized",
+        "arena/analysis/absint.py",
+        'BUCKETING_TAILS = frozenset({\n'
+        '    "bucket_size", "next_pow2", "_pow2_ceil", "pack_batch", "pack_epoch",\n'
+        '    "chunk_layout", "stage", "pad",\n'
+        '})',
+        'BUCKETING_TAILS = frozenset()',
+        "the recognized bucketing ops are the ONLY calls that launder a "
+        "raw-length size back to a safe shape; un-recognizing them turns "
+        "every real bucket_size/pack_batch call site into a finding (or, "
+        "equivalently, stops the rule from distinguishing bucketed flows "
+        "from raw ones) — killed by "
+        "test_pow2_bucketing_ops_are_recognized_sanitizers (the "
+        "bucket_size fixture must lint CLEAN)",
+    ),
+    (
+        "taint-sanitizer-check-skipped",
+        "arena/analysis/absint.py",
+        'TAINT_SANITIZER_TAILS = frozenset({\n'
+        '    "parse_submit_body", "parse_path", "_query_int", "_validate_matches",\n'
+        '    "pack_batch", "pack_epoch",\n'
+        '})',
+        'TAINT_SANITIZER_TAILS = frozenset()',
+        "the taint rule's whole meaning is 'sanitized on every path': with "
+        "sanitizer recognition skipped, the documented safe flows (request "
+        "body through parse_submit_body into the front door, "
+        "_validate_matches before store.add) read as violations — killed "
+        "by test_protocol_validators_clear_taint (both sanctioned flows "
+        "must lint CLEAN)",
+    ),
+    (
         "lint-json-format-omits-rule-name",
         "arena/analysis/jaxlint.py",
         '        "rule": finding.rule,\n        "path": finding.path,',

@@ -1,0 +1,458 @@
+"""jaxlint v3: the abstract-interpretation layer and its three rules.
+
+Four surfaces under test:
+
+1. The LATTICE — join must be a real semilattice join (commutative,
+   idempotent, associative, rank-monotone) over randomized elements;
+   a join that quietly collapses (the lattice-join-returns-bottom
+   mutant) blinds every rule riding the lattice, and the property
+   test is the named kill.
+2. The SHAPE contract — the acceptance fixture (a raw `len(...)`-
+   shaped array reaching a jitted call) is flagged, the recognized
+   bucketing ops (`bucket_size`, `pack_batch`, `pack_epoch` — incl.
+   the PR 6 `pad_batches_pow2=True` bootstrap-CI shape —
+   `chunk_layout`, staging `stage`) launder dynamism back to safety,
+   and the REAL engine/ingest/ratings call sites carry zero v3
+   findings. Un-recognizing a bucketing op (the
+   bucketing-op-not-recognized mutant) turns the ok-fixtures red.
+3. The DTYPE contract — bare 64-bit producers and json numerics are
+   flagged at the boundary, `.astype(np.int32)` / explicit dtypes
+   are not.
+4. The TAINT contract — wire sources reach sinks only through the
+   protocol validators, on EVERY path (branch envs join), one hop
+   deep through the project table, and the real wire tier is clean.
+   The taint-sanitizer-check-skipped mutant is killed by
+   `test_protocol_validators_clear_taint`.
+
+Everything here is stdlib + the linter: no jax imports needed (the
+fixtures are parsed, never executed).
+"""
+
+import pathlib
+import random
+
+from arena.analysis import absint, jaxlint
+from arena.analysis.absint import (
+    AbsValue,
+    RULE_DTYPE,
+    RULE_TAINT,
+    RULE_UNBUCKETED,
+    SHAPE_BOTTOM,
+    SHAPE_BUCKETED,
+    SHAPE_DYNAMIC,
+    join,
+    join_shape,
+    shape_constant,
+    shape_padded,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+V3_RULES = [RULE_UNBUCKETED, RULE_DTYPE, RULE_TAINT]
+
+
+def rules_of(source, rules=None):
+    return {
+        f.rule for f in jaxlint.lint_source(source, "fixture.py", rules=rules)
+    }
+
+
+# --- 1. the lattice ---------------------------------------------------------
+
+
+def _random_shape(rng):
+    pick = rng.randrange(6)
+    if pick == 0:
+        return SHAPE_BOTTOM
+    if pick == 1:
+        return SHAPE_BUCKETED
+    if pick == 2:
+        return SHAPE_DYNAMIC
+    if pick == 3:
+        return shape_constant(rng.choice([0, 1, 7, 256, 1024]))
+    if pick == 4:
+        return shape_padded(rng.choice([None, 8, 4096]))
+    return shape_constant(rng.choice([0, 1, 7, 256, 1024]))
+
+
+def _random_value(rng):
+    return AbsValue(
+        shape=_random_shape(rng),
+        dtype=rng.choice(
+            [None, "int32", "float32", "int64", "float64", "py64"]
+        ),
+        kind=rng.choice([None, "scalar", "array"]),
+        tainted=rng.random() < 0.5,
+    )
+
+
+def test_shape_join_commutative_idempotent():
+    """The property the whole layer stands on: join is a semilattice
+    join over randomized shape elements — commutative, idempotent,
+    associative, and rank-monotone (a join never loses badness)."""
+    rng = random.Random(1222)
+    for _ in range(500):
+        a, b, c = (_random_shape(rng) for _ in range(3))
+        assert join_shape(a, b) == join_shape(b, a)
+        assert join_shape(a, a) == a
+        assert join_shape(a, join_shape(b, c)) == join_shape(join_shape(a, b), c)
+        assert join_shape(a, b).rank >= max(a.rank, b.rank)
+
+
+def test_absvalue_join_commutative_idempotent_associative():
+    rng = random.Random(2026)
+    for _ in range(500):
+        a, b, c = (_random_value(rng) for _ in range(3))
+        assert join(a, b) == join(b, a)
+        assert join(a, a) == a
+        assert join(a, join(b, c)) == join(join(a, b), c)
+        # Taint joins as OR: a join never launders.
+        assert join(a, b).tainted == (a.tainted or b.tainted)
+
+
+def test_same_rank_distinct_statics_join_to_bucketed():
+    """constant(2) vs constant(4) (or constant vs padded) is no longer
+    ONE known size but still a finite shape set — the lub is bucketed,
+    never dynamic and never a silent pick-one."""
+    assert join_shape(shape_constant(2), shape_constant(4)) == SHAPE_BUCKETED
+    assert join_shape(shape_constant(8), shape_padded(8)) == SHAPE_BUCKETED
+    assert join_shape(shape_padded(4), shape_padded(4)) == shape_padded(4)
+
+
+# --- 2. the shape contract --------------------------------------------------
+
+SEEDED_LEN_FIXTURE = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "import numpy as np\n"
+    "score = jax.jit(lambda x: x.sum())\n"
+    "def ingest(matches):\n"
+    "    n = len(matches)\n"
+    "    arr = np.zeros(n, np.float32)\n"
+    "    return score(jnp.asarray(arr))\n"
+)
+
+
+def test_raw_len_shaped_array_at_jit_boundary_is_flagged():
+    """The acceptance fixture: a raw `len(...)`-shaped array reaches a
+    jitted call — flagged by exactly the v3 shape rule."""
+    assert rules_of(SEEDED_LEN_FIXTURE) == {RULE_UNBUCKETED}
+
+
+def test_shape_rule_fires_through_shape_subscript_too():
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "f = jax.jit(lambda x: x * 2.0)\n"
+        "def rescale(weights):\n"
+        "    out = np.empty(weights.shape[0], np.float32)\n"
+        "    return f(jnp.asarray(out))\n"
+    )
+    assert rules_of(src) == {RULE_UNBUCKETED}
+
+
+def test_shard_map_wrapped_callee_is_a_boundary():
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from jax.experimental.shard_map import shard_map\n"
+        "from jax.sharding import Mesh\n"
+        "from jax.sharding import PartitionSpec as P\n"
+        "mesh = Mesh(np.array(jax.devices()), ('data',))\n"
+        "@partial(shard_map, mesh=mesh, in_specs=(P('data'),), out_specs=P())\n"
+        "def kernel(x):\n"
+        "    return x * 2.0\n"
+        "def drive(batch):\n"
+        "    arr = np.zeros(len(batch), np.float32)\n"
+        "    return kernel(jnp.asarray(arr))\n"
+    )
+    assert rules_of(src, rules=[RULE_UNBUCKETED]) == {RULE_UNBUCKETED}
+
+
+def test_pow2_bucketing_ops_are_recognized_sanitizers():
+    """The kill test for the bucketing-op-not-recognized mutant: a
+    dynamic size routed through `bucket_size` (and a batch routed
+    through `pack_batch`) reaches the boundary CLEAN — if the
+    recognized-op set is emptied, these fixtures go red."""
+    via_bucket_size = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from arena.engine import bucket_size\n"
+        "score = jax.jit(lambda x: x.sum())\n"
+        "def ok(matches):\n"
+        "    b = bucket_size(len(matches))\n"
+        "    arr = np.zeros(b, np.float32)\n"
+        "    return score(jnp.asarray(arr))\n"
+    )
+    assert rules_of(via_bucket_size) == set()
+    via_pack_batch = (
+        "import jax\n"
+        "from arena.engine import pack_batch\n"
+        "score = jax.jit(lambda x: x.sum())\n"
+        "def ok(num_players, winners, losers):\n"
+        "    packed = pack_batch(num_players, winners, losers)\n"
+        "    return score(packed.valid)\n"
+    )
+    assert rules_of(via_pack_batch) == set()
+    via_chunk_layout = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from arena.ingest import chunk_layout\n"
+        "fit = jax.jit(lambda p: p.sum())\n"
+        "def ok(perm, bounds):\n"
+        "    perms, chunk_bounds = chunk_layout(perm, bounds, 4096)\n"
+        "    return fit(jnp.asarray(perms))\n"
+    )
+    assert rules_of(via_chunk_layout) == set()
+
+
+def test_pack_epoch_pow2_padded_call_sites_are_recognized():
+    """Regression for the PR 6 bootstrap-CI fix: the
+    `pack_epoch(pad_batches_pow2=True)` call shape (engine.
+    bootstrap_ratings) must read as a bucketing sanitizer — the
+    compile-free interval-refresh contract stays statically clean."""
+    src = (
+        "import jax\n"
+        "import numpy as np\n"
+        "from arena.engine import pack_epoch\n"
+        "from arena import ratings as R\n"
+        "resampler = R.jit_elo_bootstrap()\n"
+        "def refresh(num_players, winners, losers, keys, base):\n"
+        "    packed = pack_epoch(num_players, winners, losers, 8192,\n"
+        "                        pad_batches_pow2=True, min_batches=8)\n"
+        "    return resampler(base, packed.winners, packed.losers,\n"
+        "                     packed.valid, packed.perms, packed.bounds,\n"
+        "                     keys)\n"
+    )
+    assert rules_of(src) == set()
+
+
+def test_real_bucketing_call_sites_stay_clean():
+    """The other half of the acceptance criterion: the REAL
+    pack_batch / pack_epoch / chunk_layout / staging call sites in
+    engine.py, ingest.py, ratings.py, and sharding.py carry ZERO v3
+    findings (lint them with only the v3 families active, so this
+    stays a targeted pin even if other rules grow)."""
+    targets = [
+        str(REPO / "arena" / name)
+        for name in ("engine.py", "ingest.py", "ratings.py", "sharding.py")
+    ]
+    findings = jaxlint.lint_paths(targets, rules=V3_RULES)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_one_hop_shape_summary_through_the_project_table(tmp_path):
+    """Interprocedural, one hop: a helper in ANOTHER MODULE that mints
+    a dynamic-shaped array from its argument's length — the caller's
+    jit boundary is flagged through the table-resolved return
+    summary; the same helper handed a constant stays clean."""
+    (tmp_path / "helpers.py").write_text(
+        "import numpy as np\n"
+        "def expand(batch):\n"
+        "    return np.zeros(len(batch), np.float32)\n"
+    )
+    (tmp_path / "main.py").write_text(
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from helpers import expand\n"
+        "score = jax.jit(lambda x: x.sum())\n"
+        "def ingest(matches):\n"
+        "    return score(jnp.asarray(expand(matches)))\n"
+    )
+    findings = jaxlint.lint_paths([str(tmp_path)], rules=V3_RULES)
+    assert {f.rule for f in findings} == {RULE_UNBUCKETED}
+    assert all(f.path.endswith("main.py") for f in findings)
+
+
+# --- 3. the dtype contract --------------------------------------------------
+
+
+def test_bare_arange_flagged_astype_clean():
+    bare = (
+        "import jax\n"
+        "import numpy as np\n"
+        "kernel = jax.jit(lambda idx: idx.sum())\n"
+        "def refit(num_players):\n"
+        "    return kernel(np.arange(num_players))\n"
+    )
+    assert rules_of(bare) == {RULE_DTYPE}
+    cast = bare.replace(
+        "kernel(np.arange(num_players))",
+        "kernel(np.arange(num_players).astype(np.int32))",
+    )
+    assert rules_of(cast) == set()
+    pinned = bare.replace(
+        "np.arange(num_players)", "np.arange(num_players, dtype=np.int32)"
+    )
+    assert rules_of(pinned) == set()
+
+
+def test_json_numbers_need_an_explicit_dtype():
+    """json.loads numerics are Python ints/floats — np.asarray widens
+    them to 64-bit unless the wire format's int32 is pinned."""
+    drift = (
+        "import jax\n"
+        "import json\n"
+        "import numpy as np\n"
+        "kernel = jax.jit(lambda w: w.sum())\n"
+        "def load(text):\n"
+        "    doc = json.loads(text)\n"
+        "    return kernel(np.asarray(doc['scores']))\n"
+    )
+    assert rules_of(drift) == {RULE_DTYPE}
+    pinned = drift.replace(
+        "np.asarray(doc['scores'])", "np.asarray(doc['scores'], np.float32)"
+    )
+    assert rules_of(pinned) == set()
+
+
+def test_jnp_constructors_are_not_64bit_producers():
+    """Under the repo's x32 config jnp.zeros/arange default 32-bit —
+    the rule must not flag the device-side constructors the tests and
+    benches use everywhere."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "f = jax.jit(lambda x: x + 1.0)\n"
+        "def ok():\n"
+        "    return f(jnp.zeros(16))\n"
+    )
+    assert rules_of(src) == set()
+
+
+# --- 4. the taint contract --------------------------------------------------
+
+
+def test_wire_taint_reaches_sink_without_validator_is_flagged():
+    src = (
+        "import json\n"
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self, engine):\n"
+        "        raw = self.rfile.read(10)\n"
+        "        doc = json.loads(raw)\n"
+        "        engine.update(doc['winners'], doc['losers'])\n"
+    )
+    assert rules_of(src, rules=V3_RULES) == {RULE_TAINT}
+
+
+def test_protocol_validators_clear_taint():
+    """The kill test for the taint-sanitizer-check-skipped mutant:
+    the documented flows — parse_submit_body on the result, AND
+    _validate_matches validating its argument names in place — both
+    read clean; with sanitizer recognition skipped they go red."""
+    via_parse = (
+        "from http.server import BaseHTTPRequestHandler\n"
+        "from arena.net.protocol import parse_submit_body\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self, frontdoor):\n"
+        "        raw = self.rfile.read(10)\n"
+        "        winners, losers, producer = parse_submit_body(raw)\n"
+        "        frontdoor.submit(winners, losers, producer=producer)\n"
+    )
+    assert rules_of(via_parse, rules=V3_RULES) == set()
+    in_place = (
+        "import json\n"
+        "from http.server import BaseHTTPRequestHandler\n"
+        "from arena.engine import _validate_matches\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def ingest(self, store, num_players):\n"
+        "        doc = json.loads(self.rfile.read(10))\n"
+        "        w = doc['winners']\n"
+        "        l = doc['losers']\n"
+        "        _validate_matches(num_players, w, l)\n"
+        "        store.add(w, l)\n"
+    )
+    assert rules_of(in_place, rules=V3_RULES) == set()
+
+
+def test_taint_requires_sanitizer_on_every_path():
+    """Branch envs JOIN: a sanitizer on one arm of an `if` does not
+    launder the other arm — only both-arms-validated reads clean."""
+    one_arm = (
+        "import json\n"
+        "from http.server import BaseHTTPRequestHandler\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self, engine, strict):\n"
+        "        raw = self.rfile.read(10)\n"
+        "        if strict:\n"
+        "            winners, losers, producer = parse_submit_body(raw)\n"
+        "        else:\n"
+        "            doc = json.loads(raw)\n"
+        "            winners, losers = doc['winners'], doc['losers']\n"
+        "        engine.update(winners, losers)\n"
+    )
+    assert rules_of(one_arm, rules=V3_RULES) == {RULE_TAINT}
+    both_arms = one_arm.replace(
+        "            doc = json.loads(raw)\n"
+        "            winners, losers = doc['winners'], doc['losers']\n",
+        "            winners, losers, producer = parse_submit_body(raw)\n",
+    )
+    assert rules_of(both_arms, rules=V3_RULES) == set()
+
+
+def test_one_hop_taint_into_callee_sink(tmp_path):
+    """A helper module that forwards to the sink: the tainted call is
+    reported AT THE CALL SITE in the handler module, one hop through
+    the table (the helper alone, with untainted params, is clean)."""
+    (tmp_path / "sinkmod.py").write_text(
+        "def apply_raw(engine, doc):\n"
+        "    engine.update(doc['winners'], doc['losers'])\n"
+    )
+    (tmp_path / "handler.py").write_text(
+        "import json\n"
+        "from http.server import BaseHTTPRequestHandler\n"
+        "from sinkmod import apply_raw\n"
+        "class H(BaseHTTPRequestHandler):\n"
+        "    def do_POST(self):\n"
+        "        raw = self.rfile.read(10)\n"
+        "        apply_raw(self.server.engine, json.loads(raw))\n"
+    )
+    findings = jaxlint.lint_paths([str(tmp_path)], rules=V3_RULES)
+    assert {f.rule for f in findings} == {RULE_TAINT}
+    assert all(f.path.endswith("handler.py") for f in findings)
+    assert any("apply_raw" in f.message for f in findings)
+    # The helper on its own makes no claim: its params are untainted.
+    alone = jaxlint.lint_paths([str(tmp_path / "sinkmod.py")], rules=V3_RULES)
+    assert alone == []
+
+
+def test_real_wire_tier_stays_taint_clean():
+    """The real handlers route every request field through parse_path
+    / parse_submit_body before anything mutates — pinned with only
+    the v3 families active across the whole net tier + engine."""
+    targets = [
+        str(REPO / "arena" / "net" / name)
+        for name in ("server.py", "protocol.py", "frontdoor.py")
+    ] + [str(REPO / "arena" / "engine.py"), str(REPO / "arena" / "serving.py")]
+    findings = jaxlint.lint_paths(targets, rules=V3_RULES)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# --- cross-cutting: suppression + severity + registration ------------------
+
+
+def test_v3_findings_are_suppressible_inline():
+    muted = SEEDED_LEN_FIXTURE.replace(
+        "    return score(jnp.asarray(arr))\n",
+        "    return score(jnp.asarray(arr))"
+        "  # jaxlint: disable=unbucketed-shape-at-jit-boundary\n",
+    )
+    assert rules_of(muted) == set()
+
+
+def test_v3_rules_registered_with_severities():
+    for name in V3_RULES:
+        assert name in jaxlint.RULES
+        assert jaxlint.RULES[name].severity in jaxlint.SEVERITIES
+
+
+def test_analysis_is_cached_per_module_context():
+    """The three rules share ONE abstract-interp pass per module (the
+    expensive part runs once, not three times)."""
+    ctx = jaxlint.ModuleContext("f.py", SEEDED_LEN_FIXTURE)
+    first = absint._analysis(ctx)
+    assert absint._analysis(ctx) is first

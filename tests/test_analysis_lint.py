@@ -43,7 +43,15 @@ CORPUS_EXPECTED = {
     "bad_blocking_locked.py": {"blocking-while-locked"},
     "bad_lock_order.py": {"lock-order-inversion"},
     "bad_liveness_recheck.py": {"thread-no-liveness-recheck"},
+    # jaxlint v3: the abstract-interpretation families.
+    "bad_unbucketed_jit_shape.py": {"unbucketed-shape-at-jit-boundary"},
+    "bad_dtype_drift.py": {"dtype-drift-into-kernel"},
+    "bad_wire_taint.py": {"unvalidated-wire-input"},
 }
+
+# The --format=json per-finding schema (the mechanical consumption
+# contract): one object per line, these keys exactly.
+JSON_KEYS = {"rule", "path", "line", "col", "message", "suppressed", "severity"}
 
 
 def test_clean_tree_has_zero_findings():
@@ -302,9 +310,9 @@ def test_json_format_lines_carry_rule(capsys):
     assert lines
     for line in lines:
         obj = json.loads(line)
-        assert set(obj) == {"rule", "path", "line", "col", "message",
-                            "suppressed"}
+        assert set(obj) == JSON_KEYS
         assert obj["rule"] == "use-after-donate"
+        assert obj["severity"] == "error"
         assert obj["suppressed"] is False
 
 
@@ -420,8 +428,105 @@ def test_cli_subprocess_contract():
     assert as_json.returncode == 1
     json_lines = [json.loads(line) for line in as_json.stdout.splitlines()]
     assert json_lines
-    assert all(
-        set(obj) == {"rule", "path", "line", "col", "message", "suppressed"}
-        for obj in json_lines
-    )
+    assert all(set(obj) == JSON_KEYS for obj in json_lines)
+    assert all(obj["severity"] in jaxlint.SEVERITIES for obj in json_lines)
     assert {obj["rule"] for obj in json_lines} == set(jaxlint.RULES)
+
+
+# --- v3 CLI satellites: rule selection + multi-bad-path reporting ---------
+
+
+def test_rules_flag_runs_only_the_named_rules(capsys):
+    """--rules=<a,b> runs the named rules in isolation (how the
+    expensive abstract-interp families run alone); rc semantics
+    unchanged — findings rc 1, clean rc 0."""
+    target = str(CORPUS / "bad_use_after_donate.py")
+    rc = jaxlint.main(["--rules=use-after-donate", target])
+    assert rc == 1
+    assert "use-after-donate" in capsys.readouterr().out
+    # The same file under an unrelated rule selection is clean: rc 0.
+    rc = jaxlint.main(["--rules=mutable-closure", target])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_disable_flag_skips_the_named_rules(capsys):
+    target = str(CORPUS / "bad_use_after_donate.py")
+    rc = jaxlint.main(["--disable=use-after-donate", target])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+    # --rules then --disable compose: select two, disable one.
+    multi = str(CORPUS / "bad_dtype_drift.py")
+    rc = jaxlint.main([
+        "--rules=dtype-drift-into-kernel,use-after-donate",
+        "--disable=dtype-drift-into-kernel", multi,
+    ])
+    assert rc == 0
+
+
+def test_unknown_rule_name_is_a_usage_error(capsys):
+    assert jaxlint.main(["--rules=no-such-rule", str(CORPUS)]) == 2
+    assert "no-such-rule" in capsys.readouterr().err
+    assert jaxlint.main(["--disable=also-not-a-rule", str(CORPUS)]) == 2
+    assert "also-not-a-rule" in capsys.readouterr().err
+
+
+def test_list_rules_names_severity_for_every_rule(capsys):
+    assert jaxlint.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for name, r in jaxlint.RULES.items():
+        assert f"{name} [{r.severity}]:" in out
+
+
+def test_rc2_reports_every_bad_path_in_one_run(capsys):
+    """The rc-2 satellite: BOTH missing targets named, each on its own
+    line, in one run (previously effectively first-error-only), in the
+    human format..."""
+    rc = jaxlint.main([str(REPO / "nope-one"), str(REPO / "nope-two"),
+                       str(CORPUS)])
+    assert rc == 2
+    err_lines = [
+        line for line in capsys.readouterr().err.splitlines()
+        if line.startswith("jaxlint:")
+    ]
+    assert len(err_lines) == 2
+    assert "nope-one" in err_lines[0] and "nope-two" in err_lines[1]
+
+
+def test_rc2_reports_every_bad_path_as_json_lines(capsys):
+    """...and in --format=json: one structured object per bad path."""
+    rc = jaxlint.main([
+        "--format=json", str(REPO / "nope-one"), str(REPO / "nope-two"),
+    ])
+    assert rc == 2
+    objs = [json.loads(line) for line in capsys.readouterr().out.splitlines()]
+    assert len(objs) == 2
+    assert all(obj["error"] == "bad-path" for obj in objs)
+    assert [pathlib.Path(o["path"]).name for o in objs] == [
+        "nope-one", "nope-two"
+    ]
+
+
+def test_unreadable_file_reports_rc2_with_path_named(
+    tmp_path, capsys, monkeypatch
+):
+    """A directory walk that hits an unreadable .py file reports it
+    (rc 2, path named) instead of crashing — and still names EVERY
+    other bad path in the same run. (chmod can't simulate this under
+    the root test runner, so the read failure is injected.)"""
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    blocked = tmp_path / "blocked.py"
+    blocked.write_text("y = 2\n")
+    real_read_text = pathlib.Path.read_text
+
+    def flaky_read_text(self, *args, **kwargs):
+        if self.name == "blocked.py":
+            raise PermissionError(13, "Permission denied")
+        return real_read_text(self, *args, **kwargs)
+
+    monkeypatch.setattr(pathlib.Path, "read_text", flaky_read_text)
+    rc = jaxlint.main([str(tmp_path), str(tmp_path / "missing-too")])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "blocked.py" in err
+    assert "missing-too" in err

@@ -29,14 +29,36 @@ CONCURRENCY_RULES = {
     "thread-no-liveness-recheck",
 }
 
+# jaxlint v3: the abstract-interpretation families.
+ABSINT_RULES = {
+    "unbucketed-shape-at-jit-boundary",
+    "dtype-drift-into-kernel",
+    "unvalidated-wire-input",
+}
+
 
 def test_full_tree_lints_clean_with_concurrency_rules_active():
     """The acceptance criterion: `python -m arena.analysis` over the
-    clean tree reports 0 findings WITH the four concurrency rules
-    registered and the real guarded_by annotations in place."""
+    clean tree reports 0 findings WITH the four concurrency rules AND
+    the three v3 abstract-interpretation families registered, the real
+    guarded_by annotations in place, and the real bucketing/validator
+    call sites recognized."""
     assert CONCURRENCY_RULES <= set(jaxlint.RULES)
+    assert ABSINT_RULES <= set(jaxlint.RULES)
     findings = jaxlint.lint_paths(jaxlint.default_targets())
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_every_registered_rule_declares_a_severity():
+    """The --format=json `severity` field is only as stable as the
+    registry behind it: every rule must declare one of the closed
+    severity vocabulary (no default exists — a new rule without one
+    fails at registration, and this test pins the vocabulary)."""
+    assert jaxlint.SEVERITIES == ("error", "warning")
+    for name, r in jaxlint.RULES.items():
+        assert r.severity in jaxlint.SEVERITIES, (
+            f"rule {name!r} declares severity {r.severity!r}"
+        )
 
 
 def test_clean_pass_is_not_vacuous():

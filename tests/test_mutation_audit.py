@@ -67,13 +67,16 @@ def test_mutations_cover_every_policed_surface():
     shed-coalesce summary update, the wire response envelope), and since
     PR 10 the jaxlint v2 engine (the symbol table's import resolution,
     the held-lock scanner's with-block tracking, the lock-order graph's
-    edges, the JSON output schema)."""
+    edges, the JSON output schema), and since PR 11 the jaxlint v3
+    abstract interpreter (the shape-lattice join, the recognized
+    bucketing-op set, the taint sanitizer check)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
         "verify_reference.py",
         "arena/analysis/jaxlint.py",
         "arena/analysis/project.py",
+        "arena/analysis/absint.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",
@@ -109,6 +112,7 @@ def _fake_sources_only(dest):
         "verify_reference.py",
         "arena/analysis/jaxlint.py",
         "arena/analysis/project.py",
+        "arena/analysis/absint.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",
