@@ -517,6 +517,11 @@ def run_ingest_benchmark():
     # scheduler state favor neither side. ----------------------------
     obs_live = obs_pkg.Observability()
     _register_active_obs(obs_live)
+    # The ops plane runs LIVE through the measured region (PR 13): the
+    # <3% budget covers window rotation + profiler sampling, not just
+    # the registry writes.
+    obs_live.enable_ops(interval_s=0.5)
+    obs_live.start_ops()
     all_slices = _batch_slices(total, batch)
     null_build_s = float("inf")
     live_build_s = float("inf")
@@ -553,10 +558,13 @@ def run_ingest_benchmark():
         and np.array_equal(bounds_null, bounds_live)
     ):
         raise EquivalenceError(float("inf"), tol)
+    obs_live.stop_ops()
     obs_gate["spans_recorded"] = obs_live.tracer.recorded
     obs_gate["csr_merges_counted"] = obs_live.registry.counter_sum(
         "arena_ingest_matches_total"
     )
+    obs_gate["window_rotations"] = obs_live.windows.health()["rotations"]
+    obs_gate["profiler_samples"] = obs_live.profiler.samples
 
     # --- equivalence gate, Elo: the incremental engine path must land
     # on the same ratings as a cold pack + fused epoch ----------------
@@ -694,6 +702,10 @@ def run_pipeline_benchmark():
     # default NullRegistry, i.e. the pre-instrumentation behavior).
     obs_live = obs_pkg.Observability()
     _register_active_obs(obs_live)
+    # Windows + profiler run live through the measured streams (PR 13):
+    # the <3% budget covers the whole ops plane, not just the registry.
+    obs_live.enable_ops(interval_s=0.5)
+    obs_live.start_ops()
     eng_sync = engine.ArenaEngine(num_players)
     eng_async = engine.ArenaEngine(num_players)
     eng_cold = engine.ArenaEngine(num_players)
@@ -784,8 +796,11 @@ def run_pipeline_benchmark():
     # overhead budget (HARD gate, rc 2 on breach).
     if not np.array_equal(r_obs, r_async):
         raise EquivalenceError(float(np.abs(r_obs - r_async).max()), 0.0)
+    obs_live.stop_ops()
     obs_gate = _gate_obs_overhead(async_s, obs_async_s)
     obs_gate["spans_recorded"] = obs_live.tracer.recorded
+    obs_gate["window_rotations"] = obs_live.windows.health()["rotations"]
+    obs_gate["profiler_samples"] = obs_live.profiler.samples
     eng_obs.shutdown()
     speedup = sync_s / async_s
 
@@ -1039,7 +1054,10 @@ def run_soak_benchmark():
     bootstrap, packer thread — a recompile in the serving loop is a
     multi-second stall for every concurrent reader), and the final
     ratings must be equivalent to a sync replay of the same stream
-    (plus the serve-mode torn-view invariants on every response)."""
+    (plus the serve-mode torn-view invariants on every response).
+    Since PR 13 a third gate rides along: the SLO burn-rate engine
+    runs live over the sliding windows and must stay SILENT — a soak
+    is the steady state, so any alert here is a broken alert."""
     base_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
     stream_batch = _env_int("ARENA_BENCH_DELTA", 10_000)
     soak_batches = _env_int("ARENA_BENCH_SOAK_BATCHES", 16)
@@ -1061,6 +1079,11 @@ def run_soak_benchmark():
 
     obs_live = obs_pkg.Observability(trace_capacity=8192)
     _register_active_obs(obs_live)
+    # Ops plane live for the whole soak (PR 13): 60x1s ring so the
+    # full measured window stays inside the slow burn-rate window, and
+    # the steady-state silence gate below reads real evaluations.
+    obs_live.enable_ops(interval_s=1.0, intervals=60)
+    obs_live.start_ops()
     srv = serving.ArenaServer(
         num_players=num_players,
         max_staleness_matches=stream_batch,
@@ -1174,6 +1197,20 @@ def run_soak_benchmark():
             "soak's steady state; the compile-free contract (ROADMAP "
             "item 5) promises zero"
         )
+    # --- SLO silence HARD gate (PR 13): a soak is the steady state by
+    # definition — a burn-rate alert firing here means the alerting
+    # math (or the system) is broken, rc 2 either way. ----------------
+    slo_eval = obs_live.slo.evaluate()
+    obs_live.stop_ops()
+    if obs_live.slo.alerts_fired() != 0:
+        fired = [
+            name for name, o in slo_eval["objectives"].items()
+            if o["fired_total"]
+        ] or [f["slo"] for f in obs_live.slo.firings()]
+        raise SoakGateError(
+            f"SLO burn-rate alert(s) fired during the soak's steady "
+            f"state: {fired}; a healthy steady state must stay silent"
+        )
 
     streamed = stream_batch * soak_batches
     p50 = lat_hist.percentile(0.5)
@@ -1230,6 +1267,14 @@ def run_soak_benchmark():
             "trace_dangling_orphans": dangling_orphans,
             "p99_exemplar": p99_exemplar,
             "max_view_mass_dev": round(max_mass_dev[0], 6),
+            "slo": {
+                "alerts_fired": obs_live.slo.alerts_fired(),
+                "objectives": sorted(slo_eval["objectives"]),
+                "window_rotations": (
+                    obs_live.windows.health()["rotations"]
+                ),
+                "profiler_samples": obs_live.profiler.samples,
+            },
         },
         "equivalence_ok": True,
         "max_rating_diff": round(max_diff, 6),
@@ -1272,6 +1317,11 @@ def run_frontend_benchmark():
 
     obs_live = obs_pkg.Observability(trace_capacity=16384)
     _register_active_obs(obs_live)
+    # Configure the ops plane BEFORE the server: enable_ops() is
+    # first-call-wins, so these knobs (1s sub-intervals, 60-deep ring)
+    # hold when `ArenaServer.__init__` and `wire.start()` re-enter it.
+    obs_live.enable_ops(interval_s=1.0, intervals=60)
+    obs_live.start_ops()
     srv = serving.ArenaServer(
         num_players=num_players,
         max_staleness_matches=stream_batch,
@@ -1397,6 +1447,20 @@ def run_frontend_benchmark():
         raise EquivalenceError(float("inf"), tol)
     if not max_mass_dev[0] < tol:
         raise EquivalenceError(max_mass_dev[0], tol)
+    # --- SLO HARD gate, half 1: SILENT at steady state ----------------
+    # The burn-rate engine has been evaluating live over the sliding
+    # windows since start_ops(); a healthy phase 1 (nothing shed,
+    # nothing 5xx) must not have tripped a single alert.
+    slo_engine = obs_live.slo
+    slo_engine.evaluate()
+    if slo_engine.alerts_fired() != 0:
+        fired = sorted({f["slo"] for f in slo_engine.firings()})
+        raise FrontendGateError(
+            f"SLO burn-rate alert(s) fired during the steady state: "
+            f"{fired}; an alert that fires on a healthy phase 1 is a "
+            "broken alert (inverted threshold, wrong selector, or a "
+            "window that never rotates)"
+        )
     phase1_shed = frontdoor.shed_batches
     qps = counts["queries"] / elapsed
     streamed = producers * frontend_batches * stream_batch
@@ -1420,10 +1484,17 @@ def run_frontend_benchmark():
     ]
     for t in overload_threads:
         t.start()
+    # Evaluate the burn-rate engine WHILE the overload runs: shedding
+    # is happening right now, and the fast window must catch it live
+    # (the alert has to fire during the incident, not in a post-mortem).
+    while any(t.is_alive() for t in overload_threads):
+        slo_engine.evaluate()
+        time.sleep(0.02)
     for t in overload_threads:
         t.join(timeout=600.0)
     staleness_peak = frontdoor.max_staleness_seen
     staleness_bound = frontdoor.staleness_bound(stream_batch, producers=producers)
+    slo_engine.evaluate()
     frontdoor.resume()
     frontdoor.flush()
     if torn:
@@ -1459,6 +1530,58 @@ def run_frontend_benchmark():
             "request's trace must chain to an allocated root"
         )
 
+    # --- SLO HARD gate, half 2: MUST fire under forced overload ------
+    # Phase 2 dropped matches by design, so the submit-delivery burn
+    # rate went through the roof — an engine that stayed silent would
+    # never page on the real thing.
+    slo_firings = slo_engine.firings("submit-delivery")
+    if not slo_firings:
+        raise FrontendGateError(
+            "the forced-overload phase shed "
+            f"{overload_shed} batches but the submit-delivery SLO "
+            "burn-rate alert never fired; an alert that sleeps through "
+            "a forced overload would sleep through a real one"
+        )
+    exemplar_tid = int(slo_firings[-1]["trace_id"])
+    if exemplar_tid <= 0:
+        raise FrontendGateError(
+            "the submit-delivery burn-rate alert fired without an "
+            "exemplar trace id; an alert must hand the operator one "
+            "concrete offending request"
+        )
+    if not obs_live.tracer.trace(exemplar_tid):
+        raise FrontendGateError(
+            f"the burn-rate alert's exemplar trace {exemplar_tid} "
+            "resolves to zero recorded spans; the exemplar must point "
+            "at a real trace in the ring"
+        )
+    # --- /debug plane HARD gate: the ops plane over real HTTP --------
+    # Every /debug endpoint must answer 200 with the standard envelope
+    # (watermark + trace_id) — same wire contract as the query tier.
+    debug_client = net.WireClient(wire.host, wire.port)
+    debug_paths = (
+        "/debug/window", "/debug/slo", "/debug/profile",
+        f"/debug/trace/{exemplar_tid}",
+    )
+    try:
+        for path in debug_paths:
+            status, resp = debug_client.get(path)
+            if status != 200:
+                raise FrontendGateError(
+                    f"GET {path} -> {status}; the ops plane must serve "
+                    "live next to the query tier"
+                )
+            if not isinstance(resp, dict) or not (
+                "watermark" in resp and "trace_id" in resp
+            ):
+                raise FrontendGateError(
+                    f"GET {path} answered without the standard envelope "
+                    "(watermark + trace_id); the /debug family wears the "
+                    "same wire contract as every other endpoint"
+                )
+    finally:
+        debug_client.close()
+
     # --- the equivalence HARD gate: sync replay of the applied log ---
     # (both phases, summary updates included) in sequence order.
     eng_sync = engine.ArenaEngine(num_players)
@@ -1480,6 +1603,25 @@ def run_frontend_benchmark():
     )
     p50 = lat.percentile(0.5)
     p99 = lat.percentile(0.99)
+    # Per-endpoint wire latency from the WINDOWED view (satellite b):
+    # rolling quantiles over the run's sliding window, per endpoint.
+    window_delta = obs_live.windows.delta()
+    wire_latency_by_endpoint = {}
+    for ep in net.ENDPOINTS:
+        wh = window_delta.histogram(
+            "arena_http_request_latency_seconds", match={"endpoint": ep}
+        )
+        if wh is None or wh.count == 0:
+            continue
+        ep_p50, ep_p99 = wh.percentile(0.5), wh.percentile(0.99)
+        wire_latency_by_endpoint[ep] = {
+            "p50_ms": round(ep_p50 * 1e3, 3) if ep_p50 is not None else None,
+            "p99_ms": round(ep_p99 * 1e3, 3) if ep_p99 is not None else None,
+            "requests": int(wh.count),
+        }
+    window_rotations = obs_live.windows.health()["rotations"]
+    profiler_samples = obs_live.profiler.samples
+    slo_fired_total = slo_engine.alerts_fired()
     wire.close()
     frontdoor.close()
     srv.close()
@@ -1526,6 +1668,18 @@ def run_frontend_benchmark():
             "trace_dangling_orphans": 0,  # gate raised otherwise
             "steady_state_new_compiles": 0,  # sentinel raised otherwise
             "max_view_mass_dev": round(max_mass_dev[0], 6),
+            "wire_latency_by_endpoint": wire_latency_by_endpoint,
+            "slo": {
+                "alerts_fired": slo_fired_total,
+                "exemplar_trace_id": exemplar_tid,
+                "firings": [
+                    {"slo": f["slo"], "burn_fast": round(f["burn_fast"], 3)}
+                    for f in slo_firings
+                ],
+                "window_rotations": window_rotations,
+                "profiler_samples": profiler_samples,
+            },
+            "debug_endpoints_ok": True,  # gate raised otherwise
         },
         "equivalence_ok": True,
         "max_rating_diff": round(max_diff, 6),

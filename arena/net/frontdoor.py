@@ -90,6 +90,11 @@ SUMMARY_PRODUCER = "coalesced"
 # Wait quantum: every blocking loop re-checks worker liveness.
 _WAIT_S = 0.05
 
+# Part of the observability contract: the sampling profiler
+# (arena/obs/profile.py) maps this thread name to the "dispatcher"
+# role. Rename here and the profiler's role table moves with it.
+MERGE_THREAD_NAME = "arena-frontdoor-merge"
+
 
 class FrontDoorError(RuntimeError):
     """The front door cannot make progress (worker dead or errored)."""
@@ -165,7 +170,7 @@ class FrontDoor:
         if engine._pipeline is None:
             engine.start_pipeline(producer=pipeline_producer)
         self._thread = threading.Thread(
-            target=self._merge_loop, name="arena-frontdoor-merge", daemon=True
+            target=self._merge_loop, name=MERGE_THREAD_NAME, daemon=True
         )
         self._thread.start()
 
@@ -311,6 +316,15 @@ class FrontDoor:
             ).inc()
             obs.event("shed", policy=POLICY_COALESCE, producer=item.producer,
                       batches=1, matches=n)
+            # Shed magnitude with the shed batch's OWN trace id as the
+            # exemplar: the submit-delivery SLO alert resolves it into
+            # the admission->shed trace of a batch that actually burned
+            # budget (the trace ends with the pipeline.dropped marker
+            # recorded just below).
+            obs.histogram("arena_shed_batch_matches", base=1.0).record(
+                float(n),
+                trace_id=item.ctx.trace_id if item.ctx is not None else 0,
+            )
             self._end_dropped_trace(item.ctx)
         while self._summary_matches > self.max_staleness_matches:
             producer, w, _l = self._summary.popleft()

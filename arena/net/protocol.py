@@ -28,6 +28,10 @@ import numpy as np
 
 ENDPOINTS = (
     "leaderboard", "player", "h2h", "submit", "stats", "healthz",
+    # The live ops plane (PR 13): windowed metrics, SLO burn rates,
+    # profiler stacks, and trace resolution — all GET, all wearing the
+    # standard envelope.
+    "debug_window", "debug_slo", "debug_profile", "debug_trace",
 )
 
 # Default leaderboard page when the query string omits one.
@@ -91,6 +95,21 @@ def parse_path(method, path):
     elif route == "submit" and len(parts) == 1:
         endpoint, want = "submit", "POST"
         parsed = {}
+    elif (
+        route == "debug"
+        and len(parts) == 2
+        and parts[1] in ("window", "slo", "profile")
+    ):
+        endpoint, want = "debug_" + parts[1], "GET"
+        parsed = {}
+    elif route == "debug" and len(parts) == 3 and parts[1] == "trace":
+        endpoint, want = "debug_trace", "GET"
+        try:
+            parsed = {"trace_id": int(parts[2])}
+        except ValueError:
+            raise ProtocolError(
+                400, f"trace id must be an integer, got {parts[2]!r}"
+            ) from None
     else:
         raise ProtocolError(404, f"no such endpoint: {split.path!r}")
     if method != want:

@@ -9,6 +9,17 @@ already-JSON-shaped serving responses over six endpoints:
     GET  /h2h?a=&b=                   Elo P(a beats b)
     POST /submit                      admit one batch at the front door
     GET  /stats                       the registry's Prometheus render()
+    GET  /debug/window                sliding-window rates + quantiles
+    GET  /debug/slo                   burn-rate evaluation, alert states
+    GET  /debug/profile               sampled stacks by thread role
+    GET  /debug/trace/{id}            one trace's spans, oldest first
+
+The /debug family is the live ops plane (PR 13): the same envelope,
+span, and counter treatment as every other endpoint (the audit's
+debug-endpoint-omits-envelope mutant pins that), served from the
+`Observability` the registry already lives in. `start()` starts the
+ops-plane threads (window rotation + profiler sampling) next to the
+accept loop; `close()` stops them.
 
 One request reads ONE immutable `ServingView` (the `ArenaServer.query`
 contract — the handler never touches engine internals), and every JSON
@@ -136,7 +147,41 @@ class _Handler(BaseHTTPRequestHandler):
             return 200, srv.query(pairs=[(params["a"], params["b"])])
         if endpoint == "submit":
             return self._submit(wire, body_raw)
+        if endpoint == "debug_window":
+            return 200, wire.obs.windows.read()
+        if endpoint == "debug_slo":
+            return 200, wire.obs.slo.evaluate()
+        if endpoint == "debug_profile":
+            return 200, wire.obs.profiler.snapshot()
+        if endpoint == "debug_trace":
+            return 200, self._trace_payload(wire, params["trace_id"])
         raise protocol.ProtocolError(404, f"no such endpoint: {endpoint!r}")
+
+    def _trace_payload(self, wire, trace_id):
+        """Resolve one trace id (a response's `trace_id`, an SLO
+        alert's exemplar) into its recorded spans. 404 when the ring
+        kept nothing for it — evicted or never allocated. The payload
+        key is `queried_trace_id`: the envelope's own `trace_id` slot
+        belongs to THIS request's trace, authoritatively."""
+        spans = wire.obs.tracer.trace(trace_id)
+        if not spans:
+            raise protocol.ProtocolError(
+                404, f"no spans recorded for trace {trace_id}"
+            )
+        return {
+            "queried_trace_id": trace_id,
+            "spans": [
+                {
+                    "name": r.name,
+                    "start": r.start,
+                    "duration": r.duration,
+                    "tid": r.tid,
+                    "span_id": r.span_id,
+                    "parent_id": r.parent_id,
+                }
+                for r in spans
+            ],
+        }
 
     def _submit(self, wire, body_raw):
         frontdoor = wire.frontdoor
@@ -184,6 +229,9 @@ class ArenaHTTPServer:
     def start(self):
         if self._thread is not None:
             raise RuntimeError("wire server already started")
+        # The ops plane serves live at /debug/*: rotation + sampling
+        # threads ride the wire server's lifecycle (no-op on NULL obs).
+        self.obs.start_ops()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             kwargs={"poll_interval": 0.05},
@@ -199,6 +247,7 @@ class ArenaHTTPServer:
             self._thread.join(timeout=10.0)
             self._thread = None
         self._httpd.server_close()
+        self.obs.stop_ops()
 
     def __enter__(self):
         return self.start()

@@ -20,6 +20,21 @@ later PR — network tier, replicas, multi-host — will report through):
 - `arena.obs.regress`  — the perf-regression watchdog CLI
   (`python -m arena.obs.regress`) comparing the newest bench-history
   line against a pinned baseline.
+- `arena.obs.windows`  — the live half of the registry: a ring of
+  cumulative boundary snapshots merged on read into rolling rates and
+  windowed log2 quantiles (record stays free; windowed counts stay
+  exact).
+- `arena.obs.slo`      — declarative SLOs with fast/slow multi-window
+  burn-rate alerting over the windowed views; alert transitions land
+  in the event log with the offending bucket's trace-id exemplar.
+- `arena.obs.profile`  — a continuous sampling profiler folding
+  per-thread stacks under stable thread ROLES (packer, dispatcher,
+  HTTP workers) into collapsed-stack output.
+
+`Observability.enable_ops()` constructs the three over the same
+registry (`start_ops()`/`stop_ops()` manage their two daemon
+threads); `ArenaServer` enables them by default and serves them at
+`/debug/window`, `/debug/slo`, `/debug/profile`.
 
 `Observability` bundles one registry + one tracer (+ a bounded recent-
 event log for the flight recorder) behind the small surface the
@@ -51,7 +66,21 @@ from arena.obs.metrics import (
     NullRegistry,
     Registry,
 )
+from arena.obs.profile import (
+    NullProfiler,
+    ProfilerError,
+    SamplingProfiler,
+    thread_role,
+)
+from arena.obs.slo import (
+    SLO,
+    NullSLOEngine,
+    Selector,
+    SLOEngine,
+    default_slos,
+)
 from arena.obs.tracing import NullTracer, SpanRecord, Tracer
+from arena.obs.windows import NullWindow, SlidingWindow, WindowError
 
 # Recent structured events kept for the flight recorder (drops, spills,
 # queue-depth samples). Bounded: a long soak keeps the newest.
@@ -69,6 +98,64 @@ class Observability:
         self.registry = registry if registry is not None else Registry()
         self.tracer = tracer if tracer is not None else Tracer(trace_capacity)
         self.events = deque(maxlen=event_capacity)
+        # The live ops plane (PR 13): None until enable_ops() — a plain
+        # Observability stays exactly the PR 6 cumulative registry.
+        self.windows = None
+        self.slo = None
+        self.profiler = None
+
+    # --- live ops plane (windows + SLO + profiler) -------------------
+
+    def enable_ops(self, intervals=None, interval_s=None, hz=None,
+                   slos=None, clock=None):
+        """Construct the sliding window, SLO engine, and profiler over
+        this registry (no threads yet — `start_ops()` spawns those).
+        Idempotent: the FIRST call's configuration wins, so a bench
+        that configures short intervals before handing the obs to
+        `ArenaServer` (which calls this with defaults) keeps its
+        configuration."""
+        from arena.obs import profile as _profile
+        from arena.obs import windows as _windows
+
+        if self.windows is None:
+            kwargs = {}
+            if clock is not None:
+                kwargs["clock"] = clock
+            self.windows = SlidingWindow(
+                self.registry,
+                intervals=(
+                    intervals if intervals is not None
+                    else _windows.DEFAULT_INTERVALS
+                ),
+                interval_s=(
+                    interval_s if interval_s is not None
+                    else _windows.DEFAULT_INTERVAL_S
+                ),
+                **kwargs,
+            )
+        if self.slo is None:
+            self.slo = SLOEngine(self.windows, slos=slos, obs=self)
+        if self.profiler is None:
+            self.profiler = SamplingProfiler(
+                hz=hz if hz is not None else _profile.DEFAULT_HZ
+            )
+        return self
+
+    def start_ops(self):
+        """Start the window-rotation and profiler-sampling threads
+        (enables the ops plane first if nobody did)."""
+        self.enable_ops()
+        self.windows.start()
+        self.profiler.start()
+        return self
+
+    def stop_ops(self):
+        """Stop the ops threads; windowed reads keep working in
+        on-read mode and accumulated profiles stay readable."""
+        if self.windows is not None:
+            self.windows.close()
+        if self.profiler is not None:
+            self.profiler.close()
 
     # --- delegation (the only calls instrumented modules make) -------
 
@@ -108,6 +195,17 @@ class Observability:
             "capacity": self.tracer.capacity,
             "events_recorded": len(self.events),
         }
+        if self.windows is not None:
+            out["ops"] = {
+                "window": self.windows.health(),
+                "profiler": (
+                    self.profiler.health()
+                    if self.profiler is not None else None
+                ),
+                "slo_alerts_fired": (
+                    self.slo.alerts_fired() if self.slo is not None else 0
+                ),
+            }
         return out
 
 
@@ -120,9 +218,27 @@ class _NullObservability(Observability):
     def __init__(self):
         super().__init__(registry=NullRegistry(), tracer=NullTracer(),
                          event_capacity=1)
+        self.windows = NullWindow()
+        self.slo = NullSLOEngine()
+        self.profiler = NullProfiler()
 
     def event(self, kind, **fields):
         return None
+
+    def enable_ops(self, intervals=None, interval_s=None, hz=None,
+                   slos=None, clock=None):
+        return self
+
+    def start_ops(self):
+        return self
+
+    def stop_ops(self):
+        return None
+
+    def dump(self):
+        out = super(_NullObservability, self).dump()
+        out.pop("ops", None)
+        return out
 
 
 NULL = _NullObservability()
@@ -132,15 +248,27 @@ __all__ = [
     "Gauge",
     "Histogram",
     "NULL",
+    "NullProfiler",
     "NullRegistry",
+    "NullSLOEngine",
     "NullTracer",
+    "NullWindow",
     "Observability",
+    "ProfilerError",
     "Registry",
+    "SLO",
+    "SLOEngine",
+    "SamplingProfiler",
+    "Selector",
+    "SlidingWindow",
     "SpanRecord",
     "TraceContext",
     "Tracer",
+    "WindowError",
     "attach",
     "current_context",
+    "default_slos",
+    "thread_role",
     "DEFAULT_EVENT_CAPACITY",
     "DEFAULT_LATENCY_BASE",
     "DEFAULT_NUM_BUCKETS",

@@ -16,6 +16,8 @@ directory:
       metrics.json    full registry dump (counters/gauges/histograms)
       config.json     caller-provided run configuration
       events.json     recent events + extracted queue-depth timeline
+      profile.txt     collapsed sampling-profiler stacks by thread
+                      role (empty when the ops plane never sampled)
 
 The write is ATOMIC at the directory level: everything lands in a
 `<path>.tmp` sibling first and the complete directory is renamed into
@@ -69,14 +71,24 @@ def dump_debug_bundle(obs, path, config=None):
             if e.get("kind") == "queue_depth" and "depth" in e
         ],
     }, indent=1))
+    # Collapsed stacks from the continuous sampling profiler (PR 13).
+    # Pre-ops-plane Observability objects lack the attribute; a bundle
+    # from one still writes the file so the layout never varies.
+    profiler = getattr(obs, "profiler", None)
+    (tmp / "profile.txt").write_text(
+        profiler.collapsed() if profiler is not None else ""
+    )
     (tmp / "MANIFEST.json").write_text(json.dumps({
         "bundle": "arena-debug",
         "written_at_unix": time.time(),
         "files": ["trace.json", "metrics.json", "config.json",
-                  "events.json"],
+                  "events.json", "profile.txt"],
         "spans_recorded": obs.tracer.recorded,
         "trace_dropped": obs.tracer.dropped,
         "events_recorded": len(events),
+        "profiler_samples": (
+            profiler.samples if profiler is not None else 0
+        ),
     }, indent=1, sort_keys=True))
     if path.exists():
         shutil.rmtree(path)

@@ -47,12 +47,69 @@ DEFAULT_LATENCY_BASE = 1e-6
 DEFAULT_NUM_BUCKETS = 32
 
 
+def _escape_label_value(value):
+    """Prometheus exposition escaping for label VALUES: backslash,
+    double quote, and newline — the three characters the text format
+    names. Anything else passes through verbatim. Without this, one
+    hostile label (a producer name with a quote in it) corrupts the
+    whole /stats scrape; the round-trip is pinned by a tier-1 test."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text):
+    """HELP-line escaping: backslash and newline (quotes are legal in
+    help text per the exposition format)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _label_suffix(labels):
-    """Stable `{k="v",...}` rendering (sorted keys), "" when unlabeled."""
+    """Stable `{k="v",...}` rendering (sorted keys, values escaped),
+    "" when unlabeled."""
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    inner = ",".join(
+        f'{k}="{_escape_label_value(labels[k])}"' for k in sorted(labels)
+    )
     return "{" + inner + "}"
+
+
+# `# HELP` text per metric name (exposition-format hardening: scrapers
+# and humans both read these). Names not listed render the default —
+# an honest "no help registered", never a missing HELP line.
+DEFAULT_HELP = "arena metric (no help text registered)"
+HELP_TEXTS = {
+    "arena_queries_total": "serving-tier queries answered",
+    "arena_view_refreshes_total": "leaderboard view rebuilds",
+    "arena_stale_serves_total": "queries answered from a stale view",
+    "arena_snapshots_total": "engine snapshots taken",
+    "arena_restores_total": "engine snapshot restores",
+    "arena_recompile_events_total": "XLA recompilations observed",
+    "arena_query_latency_seconds": "serving-tier query latency",
+    "arena_query_staleness_matches": "matches behind at query time",
+    "arena_ingest_matches_total": "matches ingested into the CSR store",
+    "arena_ingest_compactions_total": "CSR store compactions",
+    "arena_pipeline_submitted_batches_total":
+        "batches submitted to the ingest pipeline",
+    "arena_pipeline_dropped_batches_total":
+        "batches shed by backpressure policy",
+    "arena_pipeline_dropped_matches_total":
+        "matches shed by backpressure policy",
+    "arena_pipeline_spilled_batches_total": "batches spilled to disk",
+    "arena_pipeline_spilled_matches_total": "matches spilled to disk",
+    "arena_pipeline_enqueue_wait_seconds": "producer wait at enqueue",
+    "arena_pipeline_queue_depth": "pipeline queue depth",
+    "arena_frontdoor_staleness_matches":
+        "front-door staleness behind the engine",
+    "arena_shed_batch_matches":
+        "shed batch sizes (exemplar: the dropped trace)",
+    "arena_http_requests_total": "wire requests by endpoint and status",
+    "arena_http_request_latency_seconds": "wire request latency",
+}
 
 
 class Counter:
@@ -219,6 +276,13 @@ class Histogram:
                 if t
             ]
 
+    def counts_snapshot(self):
+        """Consistent `(counts copy, total, sum)` under the metric
+        lock — the raw cumulative form the sliding-window ring
+        (`arena/obs/windows.py`) diffs between boundaries."""
+        with self._lock:
+            return self._counts.copy(), int(self._count[0]), float(self._sum[0])
+
     def snapshot(self):
         """JSON-able summary: count, sum, p50/p99, per-bucket counts,
         per-bucket exemplars (keyed like `buckets`, overflow as
@@ -324,13 +388,16 @@ class Registry:
         return out
 
     def render(self):
-        """Prometheus text exposition (the endpoint-ready form)."""
+        """Prometheus text exposition (the endpoint-ready form):
+        `# HELP` + `# TYPE` per metric name, label values escaped."""
         lines = []
         typed = set()
         for (name, _labels), metric in self._sorted_metrics():
             kind = {"Counter": "counter", "Gauge": "gauge",
                     "Histogram": "histogram"}[type(metric).__name__]
             if name not in typed:
+                help_text = HELP_TEXTS.get(name, DEFAULT_HELP)
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
                 lines.append(f"# TYPE {name} {kind}")
                 typed.add(name)
             suffix = _label_suffix(metric.labels)
@@ -398,11 +465,15 @@ class _NullGauge:
         return None
 
 
+_NULL_COUNTS = np.zeros(1, np.int64)
+
+
 class _NullHistogram:
     name = "null"
     labels = {}
     count = 0
     sum = 0.0
+    bounds = np.zeros(0, np.float64)
 
     def record(self, value, trace_id=None):
         return None
@@ -418,6 +489,9 @@ class _NullHistogram:
 
     def exemplars(self):
         return []
+
+    def counts_snapshot(self):
+        return _NULL_COUNTS.copy(), 0, 0.0
 
     def snapshot(self):
         return {"count": 0, "sum": 0.0, "buckets": {}, "overflow": 0,

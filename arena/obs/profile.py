@@ -1,0 +1,301 @@
+"""Continuous sampling profiler: wall-clock attribution by thread role.
+
+The third leg of the live ops plane: `windows.py` says WHAT is slow
+right now, `slo.py` says WHETHER it matters, and this module says
+WHERE the time goes. A `SamplingProfiler` thread walks
+`sys._current_frames()` at a configurable hz (default ~67 — a prime
+period so the sampler does not phase-lock with 10ms/100ms work loops)
+and folds each thread's stack into a `(role, "f1;f2;...")` counter —
+the collapsed-stack format flamegraph tooling eats directly.
+
+**Roles, not thread ids.** The system already names its long-lived
+threads (`arena-ingest-packer`, `arena-frontdoor-merge`,
+`arena-wire-server`, the stdlib's per-request HTTP workers); samples
+aggregate under those stable role names so "the packer spends 40% of
+its wall clock in `_pack_batch`" survives thread restarts and reads
+the same across runs. Frame keys drop line numbers
+(`file.py:function`) so one hot function is one row, not fifty.
+
+**Overhead is bounded by construction**: sampling cost is per-SAMPLE
+(a handful of dict walks at hz), never per-request, and the stack
+table is capacity-bounded (overflow is counted, not grown). The
+ingest/pipeline bench overhead gates run with the profiler ON, so the
+<3% live-vs-null budget covers it.
+
+**Liveness discipline (PR 10)**: `wait_for_sample()` re-checks
+sampler liveness on every bounded wait, and a sampler that died
+surfaces its failure through `health()` into `ArenaServer.stats()` —
+an explicit error, never a silently frozen profile. `NullProfiler` is
+the no-op twin. No jax imports in this package.
+"""
+
+import os
+import sys
+import threading
+import time
+
+DEFAULT_HZ = 67.0
+DEFAULT_MAX_STACKS = 2048
+DEFAULT_MAX_DEPTH = 64
+
+# Bounded wait quantum for liveness re-checks while blocked on samples.
+_WAIT_QUANTUM_S = 0.05
+
+# Thread-name substring -> role. First match wins; unmatched threads
+# fold under "other" (MainThread included — a test driving the system
+# from the main thread shows up there).
+ROLE_PATTERNS = (
+    ("arena-ingest-packer", "packer"),
+    ("arena-frontdoor-merge", "dispatcher"),
+    ("arena-wire-server", "http-accept"),
+    ("Thread-", "http-worker"),  # stdlib ThreadingHTTPServer workers
+    ("arena-obs-window", "window"),
+    ("arena-obs-profiler", "profiler"),
+)
+
+
+def thread_role(name):
+    """Stable role for a thread name (see ROLE_PATTERNS)."""
+    for pattern, role in ROLE_PATTERNS:
+        if pattern in name:
+            return role
+    return "other"
+
+
+class ProfilerError(RuntimeError):
+    """Profiler misuse or a dead sampler thread."""
+
+
+class SamplingProfiler:
+    """Samples every live thread's stack at `hz`, folding into
+    per-role collapsed stacks."""
+
+    def __init__(self, hz=DEFAULT_HZ, max_stacks=DEFAULT_MAX_STACKS,
+                 max_depth=DEFAULT_MAX_DEPTH):
+        if hz <= 0 or max_stacks < 1 or max_depth < 1:
+            raise ProfilerError(
+                f"profiler needs hz > 0, max_stacks >= 1, max_depth >= 1,"
+                f" got ({hz}, {max_stacks}, {max_depth})"
+            )
+        self.hz = float(hz)
+        self.max_stacks = int(max_stacks)
+        self.max_depth = int(max_depth)
+        self._period = 1.0 / self.hz
+        self._cv = threading.Condition()
+        self._stacks = {}  # guarded_by: _cv ((role, folded) -> count)
+        self._role_samples = {}  # guarded_by: _cv (role -> thread-samples)
+        self._samples = 0  # guarded_by: _cv (sampling sweeps taken)
+        self._truncated = 0  # guarded_by: _cv (stacks past max_stacks)
+        self._thread = None  # guarded_by: _cv
+        self._closed = False  # guarded_by: _cv
+        self._failure = None  # guarded_by: _cv (sampler death reason)
+
+    # --- sampling -----------------------------------------------------
+
+    def _sample_locked(self):
+        """One sweep over every live thread's current frame (the
+        sampling thread itself excluded — its own act of sampling is
+        not signal)."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        me = threading.get_ident()
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            role = thread_role(names.get(tid, ""))
+            frames = []
+            f = frame
+            while f is not None and len(frames) < self.max_depth:
+                code = f.f_code
+                frames.append(
+                    f"{os.path.basename(code.co_filename)}:{code.co_name}"
+                )
+                f = f.f_back
+            folded = ";".join(reversed(frames))
+            key = (role, folded)
+            if key in self._stacks or len(self._stacks) < self.max_stacks:
+                self._stacks[key] = self._stacks.get(key, 0) + 1
+            else:
+                self._truncated += 1
+            self._role_samples[role] = self._role_samples.get(role, 0) + 1
+        self._samples += 1
+        self._cv.notify_all()
+
+    def sample_now(self):
+        """Take one sweep synchronously (deterministic tests, and the
+        bench's pre-bundle flush)."""
+        with self._cv:
+            self._sample_locked()
+            return self._samples
+
+    def _run(self):
+        try:
+            while True:
+                with self._cv:
+                    if self._closed:
+                        return
+                    self._cv.wait(timeout=self._period)
+                    if self._closed:
+                        return
+                    self._sample_locked()
+        except Exception as exc:  # surfaced via health()/wait_for_sample
+            with self._cv:
+                self._failure = f"{type(exc).__name__}: {exc}"
+                self._cv.notify_all()
+
+    def start(self):
+        """(Re)start the sampler thread; idempotent while one is
+        alive."""
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._closed = False
+            self._failure = None
+            self._thread = threading.Thread(
+                target=self._run, name="arena-obs-profiler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Stop the sampler; accumulated stacks remain readable."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # --- liveness (PR 10 discipline) ---------------------------------
+
+    def _check_sampler_locked(self):
+        """Raise if the sampler died — every blocked wait re-checks
+        this, so a dead sampler is an explicit `ProfilerError`, never
+        a silent hang on a frozen profile."""
+        if self._failure is not None:
+            raise ProfilerError(f"sampler thread died: {self._failure}")
+        if self._thread is None:
+            raise ProfilerError(
+                "no sampler thread running (start() the profiler before "
+                "waiting on samples)"
+            )
+        if not self._thread.is_alive() and not self._closed:
+            raise ProfilerError(
+                "sampler thread died without recording a failure"
+            )
+
+    def wait_for_sample(self, samples=1, timeout=10.0):
+        """Block until `samples` more sweeps land, re-checking sampler
+        liveness every bounded wait."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            target = self._samples + samples
+            while self._samples < target:
+                self._check_sampler_locked()
+                if time.monotonic() >= deadline:
+                    raise ProfilerError(
+                        f"profiler took no sample within {timeout:g}s"
+                    )
+                self._cv.wait(timeout=_WAIT_QUANTUM_S)
+            return self._samples
+
+    def health(self):
+        """Sampler liveness + accounting for `stats()`: `error` is
+        non-None ONLY when a started sampler died (not when the
+        profiler simply was never started or was cleanly closed)."""
+        with self._cv:
+            error = self._failure
+            thread = self._thread
+            if (
+                error is None
+                and thread is not None
+                and not thread.is_alive()
+                and not self._closed
+            ):
+                error = "sampler thread died without recording a failure"
+            return {
+                "running": thread is not None and thread.is_alive(),
+                "hz": self.hz,
+                "samples": self._samples,
+                "distinct_stacks": len(self._stacks),
+                "truncated": self._truncated,
+                "error": error,
+            }
+
+    @property
+    def samples(self):
+        with self._cv:
+            return self._samples
+
+    # --- reads --------------------------------------------------------
+
+    def collapsed(self):
+        """Collapsed-stack text (``role;f1;f2 count`` per line, hottest
+        first) — feed straight to flamegraph tooling; written into the
+        debug bundle as `profile.txt`."""
+        with self._cv:
+            items = sorted(
+                self._stacks.items(), key=lambda kv: (-kv[1], kv[0])
+            )
+        return "\n".join(
+            f"{role};{folded} {count}" if folded else f"{role} {count}"
+            for (role, folded), count in items
+        ) + ("\n" if items else "")
+
+    def snapshot(self, top=20):
+        """The `/debug/profile` payload: accounting + per-role sample
+        split + the hottest `top` stacks."""
+        with self._cv:
+            roles = dict(sorted(self._role_samples.items()))
+            items = sorted(
+                self._stacks.items(), key=lambda kv: (-kv[1], kv[0])
+            )[: max(0, int(top))]
+            health = {
+                "running": (
+                    self._thread is not None and self._thread.is_alive()
+                ),
+                "samples": self._samples,
+                "error": self._failure,
+            }
+        return {
+            "hz": self.hz,
+            "samples": health["samples"],
+            "running": health["running"],
+            "error": health["error"],
+            "roles": roles,
+            "top": [
+                {"role": role, "stack": folded, "count": count}
+                for (role, folded), count in items
+            ],
+        }
+
+
+class NullProfiler:
+    """No-op twin: identical surface, constant-time, never samples."""
+
+    enabled = False
+    hz = 0.0
+    samples = 0
+
+    def start(self):
+        return self
+
+    def close(self):
+        return None
+
+    def sample_now(self):
+        return 0
+
+    def wait_for_sample(self, samples=1, timeout=10.0):
+        return 0
+
+    def health(self):
+        return {"running": False, "hz": 0.0, "samples": 0,
+                "distinct_stacks": 0, "truncated": 0, "error": None}
+
+    def collapsed(self):
+        return ""
+
+    def snapshot(self, top=20):
+        return {"hz": 0.0, "samples": 0, "running": False, "error": None,
+                "roles": {}, "top": []}

@@ -1,0 +1,334 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+The SRE playbook's alerting math over `arena/obs/windows.py` views:
+an SLO declares an objective (availability target, or a latency
+threshold met by a target fraction of requests), the engine computes
+the window's error fraction, and
+
+    burn rate = error_fraction / (1 - target)
+
+i.e. "how many times faster than budget are we burning". Burn 1.0
+exhausts the error budget exactly at the window's end; the default
+alert threshold of 14.4 is the classic fast-burn page (at 14.4x a
+99.9% budget, a 30-day budget dies in ~2 days). An alert FIRES only
+when BOTH windows agree:
+
+- the **fast** window (default: the newest ring interval) says the
+  burn is happening *now* — so alerts clear quickly once the cause
+  stops, and
+- the **slow** window (the full ring) says enough budget actually
+  burned to matter — so a single bad second cannot page.
+
+Alert transitions are edge-triggered events in the bounded
+`Observability.events` log, carrying the trace-id exemplar of the
+offending histogram bucket (PR 7's exemplars make "show me the trace
+that burned the budget" a dict lookup, resolved via
+`Tracer.trace(id)`). `ArenaServer.stats()` embeds `evaluate()` as its
+`slo` block, `/debug/slo` serves it over the wire, and the frontend
+bench hard-gates both directions: the forced-overload phase MUST fire
+the delivery alert (with a resolvable exemplar) and the steady-state
+phase MUST stay silent.
+
+Evaluation is pull-based (each `evaluate()` reads the windows fresh);
+there is no alerting thread to die. `NullSLOEngine` is the no-op
+twin. No jax imports in this package.
+"""
+
+import threading
+
+import numpy as np
+
+from arena.obs.windows import _label_match
+
+# The classic fast-burn page threshold (Google SRE workbook chapter 5):
+# 14.4x budget burn = a 30-day 99.9% budget gone in ~2 days.
+DEFAULT_BURN_THRESHOLD = 14.4
+DEFAULT_FAST_INTERVALS = 1
+
+# Bounded per-engine record of firing transitions (the bench gate's
+# read; the full stream also lands in Observability.events).
+_FIRING_LOG_CAP = 64
+
+
+class SLOError(ValueError):
+    """Malformed SLO declaration."""
+
+
+class Selector:
+    """Names the metric series an SLO term reads: a metric name plus a
+    label `match` dict (values ending in ``*`` are prefix patterns,
+    e.g. ``{"status": "5*"}``)."""
+
+    __slots__ = ("name", "match")
+
+    def __init__(self, name, match=None):
+        self.name = name
+        self.match = dict(match) if match else {}
+
+    def to_payload(self):
+        return {"metric": self.name, "match": self.match}
+
+
+class SLO:
+    """One declarative objective.
+
+    Availability kind: `good`/`bad` counter selectors;
+    error fraction = bad / (good + bad).
+
+    Latency kind: a `latency` histogram selector plus `threshold_s`;
+    error fraction = fraction of windowed observations in buckets
+    whose upper bound exceeds the threshold (the threshold rounds UP
+    to the containing log2 bucket bound, consistent with the
+    histogram's conservative percentile semantics).
+
+    `exemplar` optionally names the histogram whose worst bucket's
+    trace-id exemplar rides along on alert transitions (defaults to
+    the latency selector for latency SLOs).
+    """
+
+    __slots__ = ("name", "target", "kind", "good", "bad", "latency",
+                 "threshold_s", "exemplar", "burn_threshold",
+                 "fast_intervals")
+
+    def __init__(self, name, target, *, good=None, bad=None, latency=None,
+                 threshold_s=None, exemplar=None,
+                 burn_threshold=DEFAULT_BURN_THRESHOLD,
+                 fast_intervals=DEFAULT_FAST_INTERVALS):
+        if not 0.0 < target < 1.0:
+            raise SLOError(f"SLO {name!r}: target must be in (0, 1), "
+                           f"got {target}")
+        if latency is not None:
+            if threshold_s is None or good is not None or bad is not None:
+                raise SLOError(
+                    f"SLO {name!r}: latency kind takes latency= + "
+                    "threshold_s= and nothing else"
+                )
+            self.kind = "latency"
+        elif good is not None and bad is not None:
+            self.kind = "availability"
+        else:
+            raise SLOError(
+                f"SLO {name!r}: declare either latency=+threshold_s= or "
+                "good=+bad="
+            )
+        if burn_threshold <= 0:
+            raise SLOError(f"SLO {name!r}: burn_threshold must be > 0")
+        self.name = name
+        self.target = float(target)
+        self.good = good
+        self.bad = bad
+        self.latency = latency
+        self.threshold_s = threshold_s
+        self.exemplar = exemplar if exemplar is not None else latency
+        self.burn_threshold = float(burn_threshold)
+        self.fast_intervals = int(fast_intervals)
+
+    def error_fraction(self, delta):
+        """(error_fraction, event_total) over one `WindowDelta`. An
+        empty window is a 0.0 error fraction — no traffic burns no
+        budget."""
+        if self.kind == "availability":
+            good = delta.counter_delta(self.good.name, self.good.match)
+            bad = delta.counter_delta(self.bad.name, self.bad.match)
+            total = good + bad
+            return (bad / total if total > 0 else 0.0), total
+        h = delta.histogram(self.latency.name, self.latency.match)
+        if h.count == 0 or h.bounds.size == 0:
+            return 0.0, 0
+        # Observations at or under the threshold's bucket bound count
+        # as good (le semantics: the threshold rounds up to its bucket).
+        idx = int(np.searchsorted(h.bounds, self.threshold_s, side="left"))
+        good = int(h.counts[: idx + 1].sum())
+        return 1.0 - good / h.count, h.count
+
+    def to_payload(self):
+        out = {"name": self.name, "kind": self.kind, "target": self.target,
+               "burn_threshold": self.burn_threshold,
+               "fast_intervals": self.fast_intervals}
+        if self.kind == "latency":
+            out["latency"] = self.latency.to_payload()
+            out["threshold_s"] = self.threshold_s
+        else:
+            out["good"] = self.good.to_payload()
+            out["bad"] = self.bad.to_payload()
+        return out
+
+
+def default_slos():
+    """The serving tier's stock objectives:
+
+    - **wire-availability**: 99.9% of wire requests answer non-5xx
+      (4xx are the client's error budget, not ours — excluded).
+    - **wire-read-latency**: 99% of wire requests answer within 250ms
+      (generous on purpose: it pages on collapse, not on noise).
+    - **submit-delivery**: 99.9% of submitted matches reach the
+      engine rather than being shed/dropped; the exemplar rides the
+      shed-magnitude histogram so the alert names a trace that was
+      actually dropped.
+    """
+    return [
+        SLO(
+            "wire-availability",
+            target=0.999,
+            good=Selector("arena_http_requests_total",
+                          match={"status": "2*"}),
+            bad=Selector("arena_http_requests_total",
+                         match={"status": "5*"}),
+            exemplar=Selector("arena_http_request_latency_seconds"),
+        ),
+        SLO(
+            "wire-read-latency",
+            target=0.99,
+            latency=Selector("arena_http_request_latency_seconds"),
+            threshold_s=0.25,
+        ),
+        SLO(
+            "submit-delivery",
+            target=0.999,
+            good=Selector("arena_ingest_matches_total"),
+            bad=Selector("arena_pipeline_dropped_matches_total"),
+            exemplar=Selector("arena_shed_batch_matches"),
+        ),
+    ]
+
+
+class SLOEngine:
+    """Evaluates a set of SLOs against one `SlidingWindow`, tracking
+    per-objective ok/firing state and posting edge-triggered
+    `slo_alert` events (with exemplar trace ids) into the bounded
+    event log."""
+
+    def __init__(self, window, slos=None, obs=None):
+        self._window = window
+        self._obs = obs
+        self.slos = list(slos) if slos is not None else default_slos()
+        names = [s.name for s in self.slos]
+        if len(set(names)) != len(names):
+            raise SLOError(f"duplicate SLO names: {names}")
+        self._lock = threading.Lock()
+        self._state = {s.name: "ok" for s in self.slos}  # guarded_by: _lock
+        self._fired = {s.name: 0 for s in self.slos}  # guarded_by: _lock
+        self._firing_log = []  # guarded_by: _lock (bounded, newest last)
+
+    def _exemplar_for(self, slo):
+        """The trace-id exemplar of the offending bucket: the p99
+        exemplar of the SLO's exemplar histogram, read from the LIVE
+        registry (exemplars are latest-wins, so this is the newest
+        trace through the worst bucket)."""
+        sel = slo.exemplar
+        if sel is None or self._obs is None:
+            return None
+        for (name, lkey), metric in self._obs.registry._sorted_metrics():
+            if name != sel.name or not hasattr(metric, "exemplar"):
+                continue
+            if not _label_match(dict(lkey), sel.match):
+                continue
+            ex = metric.exemplar(0.99)
+            if ex:
+                return ex
+        return None
+
+    def evaluate(self):
+        """One pull: read the fast and slow windows, compute burn
+        rates, transition alert states, return the `slo` block."""
+        slow = self._window.delta()
+        fast_cache = {}
+        objectives = {}
+        transitions = []
+        with self._lock:
+            for slo in self.slos:
+                k = slo.fast_intervals
+                if k not in fast_cache:
+                    fast_cache[k] = self._window.delta(intervals=k)
+                frac_slow, events_slow = slo.error_fraction(slow)
+                frac_fast, events_fast = slo.error_fraction(fast_cache[k])
+                budget = 1.0 - slo.target
+                burn_slow = frac_slow / budget
+                burn_fast = frac_fast / budget
+                firing = (
+                    burn_fast >= slo.burn_threshold
+                    and burn_slow >= slo.burn_threshold
+                )
+                state = "firing" if firing else "ok"
+                prev = self._state[slo.name]
+                exemplar = None
+                if state != prev:
+                    self._state[slo.name] = state
+                    exemplar = self._exemplar_for(slo)
+                    record = {
+                        "slo": slo.name,
+                        "state": state,
+                        "burn_fast": round(burn_fast, 3),
+                        "burn_slow": round(burn_slow, 3),
+                        "trace_id": (exemplar or {}).get("trace_id", 0),
+                        "exemplar": exemplar,
+                    }
+                    if state == "firing":
+                        self._fired[slo.name] += 1
+                        self._firing_log.append(record)
+                        del self._firing_log[:-_FIRING_LOG_CAP]
+                    transitions.append(record)
+                objectives[slo.name] = {
+                    "kind": slo.kind,
+                    "target": slo.target,
+                    "burn_threshold": slo.burn_threshold,
+                    "error_frac_fast": round(frac_fast, 6),
+                    "error_frac_slow": round(frac_slow, 6),
+                    "burn_fast": round(burn_fast, 3),
+                    "burn_slow": round(burn_slow, 3),
+                    "events_fast": events_fast,
+                    "events_slow": events_slow,
+                    "state": state,
+                    "fired_total": self._fired[slo.name],
+                }
+            alerts_active = sum(
+                1 for s in self._state.values() if s == "firing"
+            )
+            fired_total = sum(self._fired.values())
+        # Event posting happens outside the engine lock (the deque is
+        # its own synchronization; no lock nesting to order).
+        if self._obs is not None:
+            for record in transitions:
+                self._obs.event("slo_alert", **record)
+        return {
+            "objectives": objectives,
+            "alerts_active": alerts_active,
+            "alerts_fired_total": fired_total,
+            "window_s": round(slow.elapsed_s, 3),
+        }
+
+    def alerts_fired(self, name=None):
+        """Sticky count of ok->firing transitions (one objective, or
+        all) — what the bench's silent-at-steady-state gate reads."""
+        with self._lock:
+            if name is not None:
+                return self._fired.get(name, 0)
+            return sum(self._fired.values())
+
+    def firings(self, name=None):
+        """The recorded firing transitions (newest last), optionally
+        filtered to one objective — the bench's must-fire gate reads
+        the exemplar trace id off these."""
+        with self._lock:
+            return [
+                dict(r)
+                for r in self._firing_log
+                if name is None or r["slo"] == name
+            ]
+
+
+class NullSLOEngine:
+    """No-op twin: no objectives, never fires, constant-time."""
+
+    enabled = False
+    slos = ()
+
+    def evaluate(self):
+        return {"objectives": {}, "alerts_active": 0,
+                "alerts_fired_total": 0, "window_s": 0.0}
+
+    def alerts_fired(self, name=None):
+        return 0
+
+    def firings(self, name=None):
+        return []

@@ -1,0 +1,458 @@
+"""Sliding-window views over the cumulative metrics registry.
+
+PR 6's registry is since-boot by design (monotone counters survive any
+read pattern), which makes it useless for "what is the p99 RIGHT NOW".
+This module adds the live half without touching the hot path: a
+`SlidingWindow` keeps a ring of CUMULATIVE boundary snapshots (one per
+rotation interval, e.g. 12 x 5s) and merges on read by diffing a fresh
+cumulative snapshot against the oldest retained boundary. Because the
+ring stores cumulative states, not per-interval deltas:
+
+1. **Record stays free.** Counters/gauges/histograms are untouched —
+   no extra work per `inc()`/`record()`. The only new cost is one
+   registry sweep per rotation (each metric read under its OWN
+   existing per-metric lock, never a registry-wide freeze), so the
+   <3% live-vs-null overhead gate extends to windowed mode unchanged.
+2. **Windowed counts are exact.** A window delta is `now - boundary`
+   of exact cumulative values — the N-thread exactness property of the
+   cumulative registry carries over to every window, pinned by a
+   tier-1 test mirroring PR 6's concurrent-increment test.
+3. **Quantiles come free.** A histogram window diff is a per-bucket
+   counts subtraction; `Histogram._quantile_bucket` over the delta
+   counts gives windowed p50/p99 with the same conservative
+   upper-bound semantics (within one log2 bucket of the exact
+   percentile, property-tested against numpy offline).
+
+Rotation is hybrid: every read path calls `_advance_locked()` first
+(correct with no threads at all — tests drive a fake clock), and
+`start()` additionally spawns an "arena-obs-window" rotation thread so
+an idle server still rotates and `/debug/window` never serves a stale
+ring. The thread follows the PR 10 liveness discipline: every blocking
+wait on rotation progress re-checks the rotator's liveness
+(`thread-no-liveness-recheck`), so a dead rotator surfaces as a
+`WindowError` / `health()["error"]`, never a silently frozen window.
+
+`NullWindow` is the `NullRegistry`-style no-op twin. No jax imports in
+this package.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from arena.obs.metrics import Histogram, _label_suffix
+
+DEFAULT_INTERVALS = 12
+DEFAULT_INTERVAL_S = 5.0
+
+# Bounded wait quantum: blocked readers wake at least this often to
+# re-check rotator liveness (the PR 10 discipline).
+_WAIT_QUANTUM_S = 0.05
+
+
+class WindowError(RuntimeError):
+    """Sliding-window misuse or a dead rotation thread."""
+
+
+def _label_match(labels, match):
+    """True when `labels` superset-matches `match`; a wanted value
+    ending in ``*`` is a prefix pattern (e.g. ``status="5*"``)."""
+    if not match:
+        return True
+    for key, want in match.items():
+        have = labels.get(key)
+        if have is None:
+            return False
+        if isinstance(want, str) and want.endswith("*"):
+            if not str(have).startswith(want[:-1]):
+                return False
+        elif have != want:
+            return False
+    return True
+
+
+class WindowHistogram:
+    """A histogram's delta between two boundary snapshots: per-bucket
+    counts with the live metric's bounds, supporting the same
+    conservative bucket-upper-bound percentile read."""
+
+    __slots__ = ("bounds", "counts", "count", "sum", "elapsed_s")
+
+    def __init__(self, bounds, counts, count, sum_, elapsed_s):
+        self.bounds = bounds
+        self.counts = counts
+        self.count = count
+        self.sum = sum_
+        self.elapsed_s = elapsed_s
+
+    @property
+    def rate_per_s(self):
+        return self.count / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+    def percentile(self, q):
+        """Windowed quantile: upper bound of the bucket holding
+        quantile q of the WINDOW's observations (None when the window
+        saw none, +inf in overflow — same contract as the cumulative
+        `Histogram.percentile`)."""
+        if self.count == 0:
+            return None
+        idx = Histogram._quantile_bucket(self.counts, self.count, q)
+        if idx >= self.bounds.size:
+            return float("inf")
+        return float(self.bounds[idx])
+
+    def to_payload(self):
+        out = {
+            "count": int(self.count),
+            "rate_per_s": round(self.rate_per_s, 6),
+            "sum": round(float(self.sum), 9),
+        }
+        for name, q in (("p50", 0.5), ("p99", 0.99)):
+            p = self.percentile(q)
+            out[name] = None if p is None else (
+                p if p != float("inf") else "inf"
+            )
+        return out
+
+
+class WindowDelta:
+    """The merged view between the window's oldest retained boundary
+    and a fresh cumulative snapshot — what SLO evaluation and
+    `/debug/window` read from."""
+
+    __slots__ = ("elapsed_s", "_old", "_now")
+
+    def __init__(self, old, now):
+        self._old = old
+        self._now = now
+        self.elapsed_s = max(0.0, now["t"] - old["t"])
+
+    def _keys(self, table, name, match):
+        for key in self._now[table]:
+            if key[0] != name:
+                continue
+            if _label_match(dict(key[1]), match):
+                yield key
+
+    def counter_delta(self, name, match=None):
+        """Exact windowed count: sum of `now - boundary` over every
+        label set matching `match` (metrics born inside the window
+        diff against an implicit zero)."""
+        old = self._old["counters"]
+        total = 0
+        for key in self._keys("counters", name, match):
+            total += self._now["counters"][key] - old.get(key, 0)
+        return total
+
+    def counter_rate(self, name, match=None):
+        if self.elapsed_s <= 0:
+            return 0.0
+        return self.counter_delta(name, match) / self.elapsed_s
+
+    def gauge(self, name, match=None):
+        """Latest value of the first matching gauge (gauges are
+        last-write-wins; a window diff of one is meaningless)."""
+        for key in self._keys("gauges", name, match):
+            return self._now["gauges"][key]
+        return None
+
+    def histogram(self, name, match=None):
+        """Per-bucket delta merged across every matching label set
+        (series with mismatched bucket layouts are skipped rather than
+        mis-added)."""
+        bounds = None
+        counts = None
+        count = 0
+        sum_ = 0.0
+        old = self._old["hists"]
+        for key in self._keys("hists", name, match):
+            n_counts, n_count, n_sum, n_bounds = self._now["hists"][key]
+            o_counts, o_count, o_sum, _b = old.get(
+                key, (None, 0, 0.0, n_bounds)
+            )
+            d_counts = (
+                n_counts.copy() if o_counts is None else n_counts - o_counts
+            )
+            if bounds is None:
+                bounds = n_bounds
+                counts = d_counts
+            elif n_bounds.shape == bounds.shape and (
+                n_bounds == bounds
+            ).all():
+                counts = counts + d_counts
+            else:
+                continue
+            count += n_count - o_count
+            sum_ += n_sum - o_sum
+        if bounds is None:
+            bounds = np.zeros(0, np.float64)
+            counts = np.zeros(1, np.int64)
+        return WindowHistogram(bounds, counts, count, sum_, self.elapsed_s)
+
+    def to_payload(self):
+        """JSON-able window view: non-zero counter deltas/rates, gauge
+        spot values, histogram windows with p50/p99."""
+        counters = {}
+        for key, now_v in sorted(self._now["counters"].items()):
+            delta = now_v - self._old["counters"].get(key, 0)
+            if delta == 0:
+                continue
+            rate = delta / self.elapsed_s if self.elapsed_s > 0 else 0.0
+            counters[key[0] + _label_suffix(dict(key[1]))] = {
+                "delta": delta,
+                "rate_per_s": round(rate, 6),
+            }
+        gauges = {
+            key[0] + _label_suffix(dict(key[1])): value
+            for key, value in sorted(self._now["gauges"].items())
+        }
+        histograms = {}
+        for key in sorted(self._now["hists"]):
+            h = self.histogram(key[0], match=dict(key[1]))
+            if h.count:
+                histograms[key[0] + _label_suffix(dict(key[1]))] = (
+                    h.to_payload()
+                )
+        return {
+            "window_s": round(self.elapsed_s, 3),
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": histograms,
+        }
+
+
+class SlidingWindow:
+    """Ring of cumulative boundary snapshots over one `Registry`.
+
+    The ring holds `intervals` slots; `_head` is the slot the NEXT
+    boundary overwrites, which makes `ring[_head]` always the OLDEST
+    retained boundary — a full-window read spans between `intervals`
+    and `intervals + 1` rotation intervals of history. `delta(k)`
+    reads against the boundary k rotations back for the fast SLO
+    windows.
+    """
+
+    def __init__(self, registry, intervals=DEFAULT_INTERVALS,
+                 interval_s=DEFAULT_INTERVAL_S, clock=time.monotonic):
+        if intervals < 1 or interval_s <= 0:
+            raise WindowError(
+                f"window needs intervals >= 1 and interval_s > 0, got "
+                f"({intervals}, {interval_s})"
+            )
+        self._registry = registry
+        self.intervals = int(intervals)
+        self.interval_s = float(interval_s)
+        self._clock = clock
+        self._cv = threading.Condition()
+        seed = self._snap_cumulative()
+        self._ring = [seed] * self.intervals  # guarded_by: _cv
+        self._head = 0  # guarded_by: _cv (next slot to overwrite = oldest)
+        self._boundary = seed["t"] + self.interval_s  # guarded_by: _cv
+        self._rotations = 0  # guarded_by: _cv
+        self._thread = None  # guarded_by: _cv
+        self._closed = False  # guarded_by: _cv
+        self._failure = None  # guarded_by: _cv (rotator death reason)
+
+    # --- snapshotting -------------------------------------------------
+
+    def _snap_cumulative(self):
+        """One cumulative snapshot of every metric, each read under its
+        own per-metric lock (no registry-wide freeze, no window lock
+        required — pure reads of monotone state)."""
+        counters, gauges, hists = {}, {}, {}
+        for (name, lkey), metric in self._registry._sorted_metrics():
+            key = (name, lkey)
+            kind = type(metric).__name__
+            if kind == "Counter":
+                counters[key] = metric.value
+            elif kind == "Gauge":
+                gauges[key] = metric.value
+            else:
+                counts, count, sum_ = metric.counts_snapshot()
+                hists[key] = (counts, count, sum_, metric.bounds)
+        return {"t": self._clock(), "counters": counters, "gauges": gauges,
+                "hists": hists}
+
+    # --- rotation -----------------------------------------------------
+
+    def advance(self):
+        """Rotate every boundary the clock has crossed (0..n slots);
+        cheap no-op between boundaries. Every read path calls this, so
+        the window is correct even with no rotation thread."""
+        with self._cv:
+            return self._advance_locked()
+
+    def _advance_locked(self):
+        now = self._clock()
+        if now < self._boundary:
+            return 0
+        crossed = int((now - self._boundary) // self.interval_s) + 1
+        snap = self._snap_cumulative()
+        for _ in range(min(crossed, len(self._ring))):
+            self._ring[self._head] = snap
+            self._head = (self._head + 1) % len(self._ring)
+            self._rotations += 1
+        self._boundary += crossed * self.interval_s
+        self._cv.notify_all()
+        return crossed
+
+    def _run(self):
+        try:
+            while True:
+                with self._cv:
+                    if self._closed:
+                        return
+                    pause = max(
+                        _WAIT_QUANTUM_S,
+                        min(self._boundary - self._clock(), self.interval_s),
+                    )
+                    self._cv.wait(timeout=pause)
+                    if self._closed:
+                        return
+                    self._advance_locked()
+        except Exception as exc:  # surfaced via health()/wait_for_rotation
+            with self._cv:
+                self._failure = f"{type(exc).__name__}: {exc}"
+                self._cv.notify_all()
+
+    def start(self):
+        """(Re)start the rotation thread; idempotent while one is
+        alive."""
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._closed = False
+            self._failure = None
+            self._thread = threading.Thread(
+                target=self._run, name="arena-obs-window", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Stop the rotation thread (reads keep working in on-read
+        mode afterwards)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+            thread = self._thread
+            self._thread = None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # --- liveness (PR 10 discipline) ---------------------------------
+
+    def _check_rotator_locked(self):
+        """Raise if the rotation thread died — callers blocked on
+        rotation progress re-check this every wakeup so a dead rotator
+        is an explicit error, never a silent hang."""
+        if self._failure is not None:
+            raise WindowError(
+                f"window rotation thread died: {self._failure}"
+            )
+        if self._thread is None:
+            raise WindowError(
+                "no rotation thread running (start() the window before "
+                "waiting on rotations)"
+            )
+        if not self._thread.is_alive() and not self._closed:
+            raise WindowError(
+                "window rotation thread died without recording a failure"
+            )
+
+    def wait_for_rotation(self, rotations=1, timeout=10.0):
+        """Block until the ring rotates `rotations` more times,
+        re-checking rotator liveness every bounded wait."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            target = self._rotations + rotations
+            while self._rotations < target:
+                self._check_rotator_locked()
+                if time.monotonic() >= deadline:
+                    raise WindowError(
+                        f"window did not rotate {rotations}x within "
+                        f"{timeout:g}s"
+                    )
+                self._cv.wait(timeout=_WAIT_QUANTUM_S)
+            return self._rotations
+
+    def health(self):
+        """Rotator liveness + accounting for `stats()`: `error` is
+        None in on-read mode and after a clean close — non-None ONLY
+        when a started rotator died."""
+        with self._cv:
+            error = self._failure
+            thread = self._thread
+            if (
+                error is None
+                and thread is not None
+                and not thread.is_alive()
+                and not self._closed
+            ):
+                error = (
+                    "window rotation thread died without recording a "
+                    "failure"
+                )
+            return {
+                "mode": "thread" if thread is not None else "on-read",
+                "intervals": self.intervals,
+                "interval_s": self.interval_s,
+                "rotations": self._rotations,
+                "error": error,
+            }
+
+    # --- reads --------------------------------------------------------
+
+    def delta(self, intervals=None):
+        """Merged `WindowDelta` over the last `intervals` boundaries
+        (default: the full ring)."""
+        with self._cv:
+            self._advance_locked()
+            k = (
+                self.intervals
+                if intervals is None
+                else max(1, min(int(intervals), self.intervals))
+            )
+            old = self._ring[(self._head - k) % len(self._ring)]
+        return WindowDelta(old, self._snap_cumulative())
+
+    def read(self, intervals=None):
+        """The `/debug/window` payload: the merged window view plus
+        ring accounting and rotator health."""
+        out = self.delta(intervals=intervals).to_payload()
+        out["ring"] = self.health()
+        return out
+
+
+class NullWindow:
+    """No-op twin (the `NullRegistry` discipline): identical surface,
+    constant-time everywhere, never spawns a thread."""
+
+    enabled = False
+    intervals = 0
+    interval_s = 0.0
+
+    def start(self):
+        return self
+
+    def close(self):
+        return None
+
+    def advance(self):
+        return 0
+
+    def wait_for_rotation(self, rotations=1, timeout=10.0):
+        return 0
+
+    def health(self):
+        return {"mode": "null", "intervals": 0, "interval_s": 0.0,
+                "rotations": 0, "error": None}
+
+    def delta(self, intervals=None):
+        empty = {"t": 0.0, "counters": {}, "gauges": {}, "hists": {}}
+        return WindowDelta(empty, empty)
+
+    def read(self, intervals=None):
+        return {"window_s": 0.0, "counters": {}, "gauges": {},
+                "histograms": {}, "ring": self.health()}

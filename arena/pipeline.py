@@ -91,6 +91,13 @@ DEFAULT_QUEUE_CAPACITY = 8
 # liveness and recorded errors, so no caller can hang on a dead thread.
 _WAIT_S = 0.05
 
+# The packer thread's NAME is part of the observability contract: the
+# sampling profiler (arena/obs/profile.py) classifies threads into
+# roles by these names, so "the packer spends its wall clock in X"
+# survives restarts. Rename here and the profiler's role table moves
+# in the same commit, or the profile silently degrades to "other".
+PACKER_THREAD_NAME = "arena-ingest-packer"
+
 
 class PipelineError(RuntimeError):
     """The pipeline cannot make progress (packer dead or errored)."""
@@ -148,7 +155,7 @@ class IngestPipeline:
         self.host_pack_s = 0.0
         self.dispatch_s = 0.0  # guarded_by: _dispatch_lock
         self._thread = threading.Thread(
-            target=self._pack_loop, name="arena-ingest-packer", daemon=True
+            target=self._pack_loop, name=PACKER_THREAD_NAME, daemon=True
         )
         self._thread.start()
 

@@ -414,6 +414,12 @@ class ArenaServer:
         self._h_staleness = reg.histogram(
             "arena_query_staleness_matches", base=1.0
         )
+        # The live ops plane (PR 13): windows + SLO engine + profiler
+        # over the same registry. Construction only — no threads until
+        # a wire server's start() (or the bench) calls start_ops().
+        # First-call-wins: a caller that pre-configured intervals on
+        # its obs keeps them.
+        self.obs.enable_ops()
         self._wire_sanitizers()
 
     # --- production-mode sanitizers ----------------------------------
@@ -516,8 +522,39 @@ class ArenaServer:
                     "arena_pipeline_dropped_batches_total", "policy"
                 ),
             },
+            # The live ops plane (PR 13): burn-rate evaluation over
+            # the sliding windows, plus window/profiler thread health.
+            # A dead sampler or rotator surfaces HERE as an explicit
+            # error — never a silently frozen window.
+            "slo": self._slo_block(),
             "obs": self.obs.dump(),
         }
+
+    def _slo_block(self):
+        """One SLO evaluation + ops-thread health. `None` when the ops
+        plane is off (a NULL-obs server reports the null engine's
+        empty block instead)."""
+        if self.obs.slo is None:
+            return None
+        out = self.obs.slo.evaluate()
+        window_health = (
+            self.obs.windows.health() if self.obs.windows is not None
+            else None
+        )
+        profiler_health = (
+            self.obs.profiler.health() if self.obs.profiler is not None
+            else None
+        )
+        errors = [
+            h["error"]
+            for h in (window_health, profiler_health)
+            if h is not None and h.get("error")
+        ]
+        out["window_health"] = window_health
+        out["profiler_health"] = profiler_health
+        out["errors"] = errors
+        out["healthy"] = not errors
+        return out
 
     # --- views and staleness -----------------------------------------
 

@@ -596,6 +596,51 @@ MUTATIONS = (
         "test_json_format_lines_carry_rule (and the CLI subprocess schema "
         "check)",
     ),
+    (
+        "window-ring-never-rotates",
+        "arena/obs/windows.py",
+        "            self._head = (self._head + 1) % len(self._ring)",
+        "            self._head = (self._head + 0) % len(self._ring)",
+        "with the head frozen, every rotation overwrites the SAME slot, so "
+        "ring[head] holds the NEWEST boundary instead of the oldest and "
+        "every 'full window' silently collapses to just the last interval "
+        "— rolling rates and windowed p99s quietly under-report while all "
+        "reads still succeed — killed by "
+        "test_window_merges_counts_across_ring_intervals (counts recorded "
+        "across two rotations must BOTH be in the full-window delta)",
+    ),
+    (
+        "burn-rate-alert-threshold-inverted",
+        "arena/obs/slo.py",
+        "                firing = (\n"
+        "                    burn_fast >= slo.burn_threshold\n"
+        "                    and burn_slow >= slo.burn_threshold\n"
+        "                )",
+        "                firing = (\n"
+        "                    burn_fast <= slo.burn_threshold\n"
+        "                    and burn_slow <= slo.burn_threshold\n"
+        "                )",
+        "an inverted comparison pages on HEALTH and sleeps through "
+        "incidents — the worst possible alerting engine, and one every "
+        "steady-state read would mistake for a working one — killed by "
+        "test_burn_rate_alert_fires_only_above_threshold (silent at 0.1x "
+        "burn AND firing at 500x burn; the frontend bench hard-gates the "
+        "same both ways over real HTTP)",
+    ),
+    (
+        "debug-endpoint-omits-envelope",
+        "arena/net/server.py",
+        '        if endpoint == "debug_window":\n'
+        "            return 200, wire.obs.windows.read()",
+        '        if endpoint == "debug_window":\n'
+        "            return 200, None",
+        "a None payload routes into the /stats Prometheus-text path: the "
+        "response drops the JSON envelope (watermark + trace_id) and the "
+        "ops plane silently stops honoring the wire contract every other "
+        "endpoint carries — killed by "
+        "test_debug_endpoints_serve_the_standard_envelope (the /debug/"
+        "window body must be a JSON dict wearing the pair)",
+    ),
 )
 
 

@@ -103,6 +103,7 @@ def test_default_targets_cover_the_ingest_and_pipeline_modules():
         "ingest.py", "pipeline.py", "serving.py",
         "obs/__init__.py", "obs/metrics.py", "obs/tracing.py",
         "obs/context.py", "obs/debug.py", "obs/regress.py",
+        "obs/windows.py", "obs/slo.py", "obs/profile.py",
         "net/__init__.py", "net/protocol.py", "net/frontdoor.py",
         "net/server.py",
         "analysis/project.py", "analysis/concurrency.py",

@@ -69,7 +69,9 @@ def test_mutations_cover_every_policed_surface():
     the held-lock scanner's with-block tracking, the lock-order graph's
     edges, the JSON output schema), and since PR 11 the jaxlint v3
     abstract interpreter (the shape-lattice join, the recognized
-    bucketing-op set, the taint sanitizer check)."""
+    bucketing-op set, the taint sanitizer check), and since PR 13 the
+    live ops plane (the sliding window's ring rotation, the SLO
+    burn-rate threshold direction, the /debug wire envelope)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -84,8 +86,11 @@ def test_mutations_cover_every_policed_surface():
         "arena/obs/metrics.py",
         "arena/obs/debug.py",
         "arena/obs/regress.py",
+        "arena/obs/windows.py",
+        "arena/obs/slo.py",
         "arena/net/frontdoor.py",
         "arena/net/protocol.py",
+        "arena/net/server.py",
     }
 
 
@@ -120,8 +125,11 @@ def _fake_sources_only(dest):
         "arena/obs/metrics.py",
         "arena/obs/debug.py",
         "arena/obs/regress.py",
+        "arena/obs/windows.py",
+        "arena/obs/slo.py",
         "arena/net/frontdoor.py",
         "arena/net/protocol.py",
+        "arena/net/server.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)

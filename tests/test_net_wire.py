@@ -261,3 +261,100 @@ def test_make_response_is_the_authoritative_envelope():
         watermark=42, trace_id=7,
     )
     assert out == {"x": 1, "watermark": 42, "trace_id": 7}
+
+
+# --- the /debug ops plane (PR 13) ------------------------------------------
+
+
+def test_parse_path_routes_the_debug_family():
+    assert parse_path("GET", "/debug/window") == ("debug_window", {})
+    assert parse_path("GET", "/debug/slo") == ("debug_slo", {})
+    assert parse_path("GET", "/debug/profile") == ("debug_profile", {})
+    assert parse_path("GET", "/debug/trace/42") == (
+        "debug_trace", {"trace_id": 42},
+    )
+    for method, path, status in [
+        ("GET", "/debug", 404),
+        ("GET", "/debug/nope", 404),
+        ("GET", "/debug/trace", 404),
+        ("GET", "/debug/trace/abc", 400),
+        ("POST", "/debug/window", 405),
+        ("POST", "/debug/trace/42", 405),
+    ]:
+        with pytest.raises(ProtocolError) as exc:
+            parse_path(method, path)
+        assert exc.value.status == status, (method, path)
+
+
+def test_debug_endpoints_serve_the_standard_envelope(wire):
+    """Named kill for the audit's debug-endpoint-omits-envelope mutant
+    (a debug handler returning a None payload routes into the
+    Prometheus-text no-envelope path): every /debug response is a JSON
+    dict wearing the watermark + trace_id pair like any other
+    endpoint — the ops plane gets no special wire contract."""
+    server, client = wire
+    for path in ("/debug/window", "/debug/slo", "/debug/profile"):
+        status, resp = client.get(path)
+        assert status == 200, path
+        # dict FIRST: the mutant's symptom is a text/plain str body.
+        assert isinstance(resp, dict), path
+        assert "watermark" in resp and "trace_id" in resp, path
+        assert resp["trace_id"] > 0, path
+    status, window = client.get("/debug/window")
+    assert window["ring"]["intervals"] >= 1
+    assert window["ring"]["error"] is None
+    status, slo = client.get("/debug/slo")
+    assert "submit-delivery" in slo["objectives"]
+    assert slo["alerts_active"] == 0
+    status, prof = client.get("/debug/profile")
+    assert prof["running"] is True  # wire.start() started the sampler
+    assert prof["error"] is None
+
+
+def test_debug_trace_resolves_a_request_trace(wire):
+    """/debug/trace/{id} closes the loop the envelope opens: the
+    trace_id every response carries resolves over the SAME wire into
+    that request's recorded spans (the operator's 'show me that slow
+    request' move, no process access needed)."""
+    server, client = wire
+    status, resp = client.get("/leaderboard?offset=0&limit=5")
+    assert status == 200
+    tid = resp["trace_id"]
+    status, traced = client.get(f"/debug/trace/{tid}")
+    assert status == 200
+    assert traced["queried_trace_id"] == tid
+    names = [s["name"] for s in traced["spans"]]
+    assert "net.leaderboard" in names
+    root = next(s for s in traced["spans"] if s["name"] == "net.leaderboard")
+    assert root["parent_id"] == 0
+    # The envelope's own trace_id belongs to THIS debug request.
+    assert traced["trace_id"] != tid
+    # An id the ring never held is a structured 404, envelope included.
+    status, missing = client.get("/debug/trace/999999999")
+    assert status == 404
+    assert isinstance(missing, dict) and "error" in missing
+    assert "watermark" in missing and "trace_id" in missing
+
+
+def test_hostile_label_values_round_trip_through_the_wire_stats(wire):
+    """Satellite (a): a producer name full of quotes, backslashes, and
+    newlines must come back out of /stats as ONE well-formed escaped
+    label value — not a split line, not a broken quote (the Prometheus
+    text format's escaping rules for label values)."""
+    server, client = wire
+    hostile = 'ev"il\\x\nproducer'
+    status, _resp = client.submit(
+        np.asarray([1], np.int32), np.asarray([2], np.int32),
+        producer=hostile,
+    )
+    assert status == 202
+    server.frontdoor.flush()
+    status, text = client.get("/stats")
+    assert status == 200
+    escaped = 'producer="ev\\"il\\\\x\\nproducer"'
+    assert escaped in text
+    # The raw value must NOT appear unescaped (a newline inside a
+    # label value would split the sample line in two).
+    for line in text.splitlines():
+        assert not line.endswith('ev"il'), "unescaped newline split a line"
+    assert "# HELP arena_http_requests_total" in text

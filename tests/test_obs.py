@@ -413,3 +413,51 @@ def test_pack_epoch_pow2_padding_batches_are_rating_noops():
     r_pad = fn(r0, padded.winners, padded.losers, padded.valid, padded.perms,
                padded.bounds)
     np.testing.assert_array_equal(np.asarray(r_tight), np.asarray(r_pad))
+
+
+# --- Prometheus exposition hardening (PR 13 satellite a) -------------------
+
+
+def test_render_emits_help_and_type_lines():
+    """Every exposed metric family leads with `# HELP` then `# TYPE`
+    (the order Prometheus's parser requires), with the registered
+    help text for known arena metrics and an honest default for ad-hoc
+    ones."""
+    reg = Registry()
+    reg.counter("arena_ingest_matches_total").inc(3)
+    reg.gauge("arena_test_depth").set(1)
+    reg.histogram("arena_test_seconds").record(0.01)
+    text = reg.render()
+    lines = text.splitlines()
+    for name, kind in [
+        ("arena_ingest_matches_total", "counter"),
+        ("arena_test_depth", "gauge"),
+        ("arena_test_seconds", "histogram"),
+    ]:
+        help_idx = lines.index(
+            next(l for l in lines if l.startswith(f"# HELP {name} "))
+        )
+        type_idx = lines.index(f"# TYPE {name} {kind}")
+        assert help_idx == type_idx - 1, name
+    # Known metrics get their registered help text; unknown ones get
+    # the explicit no-help default rather than a fabricated one.
+    assert (
+        "# HELP arena_ingest_matches_total "
+        "matches ingested into the CSR store" in text
+    )
+    assert "# HELP arena_test_depth arena metric (no help text" in text
+
+
+def test_render_escapes_hostile_label_values():
+    """Label values containing quotes, backslashes, and newlines are
+    escaped per the Prometheus text format (\\\\ then \\" then \\n) so
+    one hostile producer name cannot corrupt the whole exposition."""
+    reg = Registry()
+    reg.counter("arena_test_total", producer='ev"il\\x\np').inc(2)
+    text = reg.render()
+    assert 'arena_test_total{producer="ev\\"il\\\\x\\np"} 2' in text
+    # Exactly the comment lines may start with '#'; every other line
+    # must be a well-formed `name{labels} value` sample — the raw
+    # newline would have produced a dangling `p"} 2` fragment line.
+    for line in text.splitlines():
+        assert line.startswith("#") or line.split()[0][0].isalpha(), line

@@ -393,7 +393,8 @@ def test_debug_bundle_contains_registry_dump(tmp_path):
     assert path == tmp_path / "bundle"
     manifest = json.loads((path / "MANIFEST.json").read_text())
     assert set(manifest["files"]) == {
-        "trace.json", "metrics.json", "config.json", "events.json"
+        "trace.json", "metrics.json", "config.json", "events.json",
+        "profile.txt",
     }
     assert manifest["spans_recorded"] == 1
     metrics = json.loads((path / "metrics.json").read_text())

@@ -1,0 +1,226 @@
+"""Sampling-profiler contracts (arena/obs/profile.py).
+
+The load-bearing properties:
+
+- role attribution: samples fold under the system's stable thread-role
+  names (packer/dispatcher/http-*/...), keyed by the thread-name
+  constants the worker modules export — so "where does the packer's
+  wall clock go" survives thread restarts;
+- the collapsed-stack read is flamegraph-shaped (root-first
+  `role;f1;f2 count` lines, hottest first) and lands in the debug
+  bundle as `profile.txt`;
+- the stack table is bounded: overflow increments `truncated`, never
+  grows memory;
+- PR 10 liveness (ISSUE 13 satellite f): a dead sampler thread is an
+  explicit `ProfilerError` on every blocked wait and a non-None
+  health error that surfaces through `ArenaServer.stats()` — never a
+  silently frozen profile;
+  test_dead_sampler_surfaces_error_in_stats_never_a_silent_hang is
+  the pin.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from arena import obs as obs_pkg
+from arena.net.frontdoor import MERGE_THREAD_NAME
+from arena.obs import debug
+from arena.obs import profile as profile_mod
+from arena.obs.profile import (
+    NullProfiler,
+    ProfilerError,
+    SamplingProfiler,
+    thread_role,
+)
+from arena.pipeline import PACKER_THREAD_NAME
+from arena.serving import ArenaServer
+
+
+def test_thread_roles_match_the_system_thread_names():
+    """The role table keys off the SAME name constants the worker
+    modules spawn under — renaming a thread without updating the
+    profiler's table breaks attribution, and this pins it."""
+    assert thread_role(PACKER_THREAD_NAME) == "packer"
+    assert thread_role(MERGE_THREAD_NAME) == "dispatcher"
+    assert thread_role("arena-wire-server") == "http-accept"
+    assert thread_role("Thread-3 (process_request_thread)") == "http-worker"
+    assert thread_role("arena-obs-window") == "window"
+    assert thread_role("arena-obs-profiler") == "profiler"
+    assert thread_role("MainThread") == "other"
+
+
+def test_sample_now_attributes_named_threads_to_roles():
+    prof = SamplingProfiler()
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            time.sleep(0.005)
+
+    t = threading.Thread(target=spin, name=PACKER_THREAD_NAME, daemon=True)
+    t.start()
+    try:
+        assert prof.sample_now() == 1
+        snap = prof.snapshot()
+        assert snap["samples"] == 1
+        assert "packer" in snap["roles"]
+        # The sampling thread itself (here: MainThread calling
+        # sample_now) is excluded — its own act of sampling is not
+        # signal — so "other" only appears for threads besides it.
+        packer_rows = [r for r in snap["top"] if r["role"] == "packer"]
+        assert packer_rows
+        # Root-first folded frames: file:function keys, no line numbers.
+        # Scan ALL packer rows, not just the hottest: a packer-named
+        # daemon thread leaked by an earlier test in the suite shares
+        # the role and can tie it on counts within a single sweep.
+        assert any(
+            "test_obs_profile.py:spin" in r["stack"] for r in packer_rows
+        )
+        assert json.dumps(snap)  # the /debug/profile payload is JSON-able
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_threaded_sampler_accumulates_and_survives_restart():
+    prof = SamplingProfiler(hz=200.0)
+    prof.start()
+    try:
+        assert prof.wait_for_sample(samples=3, timeout=10.0) >= 3
+        assert prof.health()["running"] is True
+        assert prof.health()["error"] is None
+    finally:
+        prof.close()
+    samples_after_close = prof.samples
+    assert samples_after_close >= 3
+    assert prof.health()["running"] is False
+    assert prof.health()["error"] is None  # a clean close is not a death
+    collapsed = prof.collapsed()
+    assert collapsed.endswith("\n")
+    assert any(
+        line.rsplit(" ", 1)[1].isdigit()
+        for line in collapsed.splitlines()
+    )
+    # start() is a restart, not a one-shot.
+    prof.start()
+    try:
+        assert prof.wait_for_sample(samples=1, timeout=10.0) > (
+            samples_after_close
+        )
+    finally:
+        prof.close()
+
+
+def test_stack_table_is_bounded_and_counts_truncation():
+    prof = SamplingProfiler(max_stacks=1)
+    stop = threading.Event()
+
+    def spin_a():
+        while not stop.is_set():
+            time.sleep(0.005)
+
+    def spin_b():
+        while not stop.is_set():
+            time.sleep(0.005)
+
+    ts = [
+        threading.Thread(target=spin_a, name=PACKER_THREAD_NAME, daemon=True),
+        threading.Thread(target=spin_b, name="arena-test-bg", daemon=True),
+    ]
+    for t in ts:
+        t.start()
+    try:
+        prof.sample_now()
+        health = prof.health()
+        # Two distinct (role, stack) keys competed for one slot: the
+        # table kept one and COUNTED the other, never grew.
+        assert health["distinct_stacks"] == 1
+        assert health["truncated"] >= 1
+    finally:
+        stop.set()
+        for t in ts:
+            t.join()
+
+
+def test_profiler_rejects_malformed_shape():
+    with pytest.raises(ProfilerError):
+        SamplingProfiler(hz=0)
+    with pytest.raises(ProfilerError):
+        SamplingProfiler(max_stacks=0)
+
+
+def test_null_profiler_is_a_true_noop_twin():
+    null = NullProfiler()
+    assert null.start() is null
+    assert null.sample_now() == 0
+    assert null.wait_for_sample() == 0
+    assert null.collapsed() == ""
+    assert null.snapshot()["top"] == []
+    assert null.health()["error"] is None
+    null.close()
+
+
+# --- PR 10 liveness discipline (satellite f) -------------------------------
+
+
+def test_dead_sampler_surfaces_error_in_stats_never_a_silent_hang(
+    monkeypatch,
+):
+    """A sampler thread killed mid-run (sys._current_frames blowing
+    up stands in for any interpreter-level surprise) must surface as
+    (1) an explicit ProfilerError from every blocked wait, (2) a
+    non-None health error, and (3) an unhealthy `slo` block in
+    `ArenaServer.stats()` — the ops plane may never present a frozen
+    profile as a quiet one."""
+
+    def boom():
+        raise RuntimeError("frames unavailable")
+
+    monkeypatch.setattr(profile_mod.sys, "_current_frames", boom)
+    obs = obs_pkg.Observability()
+    srv = ArenaServer(num_players=8, obs=obs)
+    try:
+        obs.start_ops()
+        with pytest.raises(ProfilerError, match="sampler thread died"):
+            obs.profiler.wait_for_sample(samples=1, timeout=10.0)
+        health = obs.profiler.health()
+        assert health["error"] is not None
+        assert "frames unavailable" in health["error"]
+        block = srv.stats()["slo"]
+        assert block["healthy"] is False
+        assert any("frames unavailable" in e for e in block["errors"])
+        assert block["profiler_health"]["error"] is not None
+    finally:
+        obs.stop_ops()
+        srv.close()
+
+
+def test_debug_bundle_carries_the_collapsed_profile(tmp_path):
+    obs = obs_pkg.Observability()
+    obs.enable_ops()
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            time.sleep(0.005)
+
+    t = threading.Thread(target=spin, name=PACKER_THREAD_NAME, daemon=True)
+    t.start()
+    try:
+        obs.profiler.sample_now()
+    finally:
+        stop.set()
+        t.join()
+    bundle = debug.dump_debug_bundle(obs, tmp_path / "bundle")
+    profile_txt = (tmp_path / "bundle" / "profile.txt").read_text()
+    assert profile_txt == obs.profiler.collapsed()
+    assert "packer;" in profile_txt
+    manifest = json.loads(
+        (tmp_path / "bundle" / "MANIFEST.json").read_text()
+    )
+    assert "profile.txt" in manifest["files"]
+    assert manifest["profiler_samples"] == 1
+    assert bundle == tmp_path / "bundle"

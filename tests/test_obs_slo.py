@@ -1,0 +1,256 @@
+"""SLO burn-rate engine contracts (arena/obs/slo.py).
+
+The load-bearing properties:
+
+- the burn-rate math: burn = error_fraction / (1 - target), and an
+  alert FIRES only when the fast AND slow windows both exceed the
+  threshold — the mutation audit carries a
+  burn-rate-alert-threshold-inverted mutant (both comparisons flipped
+  to <=, i.e. an engine that pages on health and sleeps through an
+  incident); test_burn_rate_alert_fires_only_above_threshold is its
+  named kill (it pins BOTH directions: silent at zero burn, firing
+  above threshold);
+- latency SLOs: the error fraction is the windowed share of
+  observations over the threshold's log2 bucket bound;
+- transitions are edge-triggered `slo_alert` events in the bounded
+  event log, carrying the trace-id exemplar of the offending bucket
+  (resolvable via `Tracer.trace`), and recovery transitions back to ok
+  while `alerts_fired` stays sticky;
+- `ArenaServer.stats()` embeds the evaluation as its `slo` block with
+  ops-thread health folded in.
+
+Fake-clock windows throughout: no sleeps, no alerting thread (the
+engine is pull-based by design).
+"""
+
+import pytest
+
+from arena import obs as obs_pkg
+from arena.obs.metrics import Registry
+from arena.obs.slo import (
+    DEFAULT_BURN_THRESHOLD,
+    NullSLOEngine,
+    SLO,
+    SLOEngine,
+    SLOError,
+    Selector,
+    default_slos,
+)
+from arena.obs.windows import SlidingWindow
+from arena.serving import ArenaServer
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def make_engine(slos, intervals=12, interval_s=5.0, obs=None):
+    reg = obs.registry if obs is not None else Registry()
+    clock = FakeClock()
+    win = SlidingWindow(
+        reg, intervals=intervals, interval_s=interval_s, clock=clock
+    )
+    return reg, clock, win, SLOEngine(win, slos=slos, obs=obs)
+
+
+AVAIL = lambda **kw: SLO(  # noqa: E731 — tiny test factory
+    "deliver",
+    target=0.999,
+    good=Selector("arena_test_good_total"),
+    bad=Selector("arena_test_bad_total"),
+    **kw,
+)
+
+
+# --- the burn-rate math (the mutation-audit kill) --------------------------
+
+
+def test_burn_rate_alert_fires_only_above_threshold():
+    """Named kill for the audit's burn-rate-alert-threshold-inverted
+    mutant (>= flipped to <=): the alert must stay SILENT while the
+    burn is under the threshold and must FIRE once both windows exceed
+    it — an inverted engine fails both halves at once."""
+    reg, clock, win, eng = make_engine([AVAIL()])
+    good = reg.counter("arena_test_good_total")
+    bad = reg.counter("arena_test_bad_total")
+
+    # Healthy traffic: tiny burn (1 bad / 10000 => frac 1e-4, burn 0.1).
+    good.inc(9999)
+    bad.inc(1)
+    out = eng.evaluate()
+    obj = out["objectives"]["deliver"]
+    assert obj["burn_slow"] < DEFAULT_BURN_THRESHOLD
+    assert obj["state"] == "ok"
+    assert out["alerts_active"] == 0
+    assert eng.alerts_fired() == 0
+
+    # Incident: half the matches drop => frac ~0.5, burn ~500 >> 14.4.
+    bad.inc(10000)
+    out = eng.evaluate()
+    obj = out["objectives"]["deliver"]
+    assert obj["burn_fast"] > DEFAULT_BURN_THRESHOLD
+    assert obj["burn_slow"] > DEFAULT_BURN_THRESHOLD
+    assert obj["state"] == "firing"
+    assert out["alerts_active"] == 1
+    assert eng.alerts_fired() == 1
+
+
+def test_alert_requires_fast_and_slow_agreement():
+    """Multi-window: a burst that has already LEFT the fast window
+    cannot page, however much slow-window budget it burned — the
+    incident must be happening *now*."""
+    reg, clock, win, eng = make_engine(
+        [AVAIL()], intervals=12, interval_s=5.0
+    )
+    reg.counter("arena_test_good_total").inc(100)
+    reg.counter("arena_test_bad_total").inc(900)
+    # Rotate the burst out of the 1-interval fast window (but keep it
+    # well inside the slow one).
+    clock.tick(5.0)
+    win.advance()
+    clock.tick(5.0)
+    win.advance()
+    out = eng.evaluate()
+    obj = out["objectives"]["deliver"]
+    assert obj["burn_slow"] > DEFAULT_BURN_THRESHOLD
+    assert obj["burn_fast"] == 0.0
+    assert obj["state"] == "ok"
+    assert eng.alerts_fired() == 0
+
+
+def test_empty_window_burns_no_budget():
+    """No traffic is 0.0 error fraction, not 0/0: a freshly started
+    (or idle) service must not page on silence."""
+    _reg, _clock, _win, eng = make_engine([AVAIL()])
+    out = eng.evaluate()
+    obj = out["objectives"]["deliver"]
+    assert obj["burn_fast"] == 0.0
+    assert obj["burn_slow"] == 0.0
+    assert obj["state"] == "ok"
+
+
+def test_latency_slo_error_fraction_over_threshold_bucket():
+    slo = SLO(
+        "read-latency",
+        target=0.9,
+        latency=Selector("arena_test_seconds"),
+        threshold_s=0.25,
+    )
+    reg, clock, win, eng = make_engine([slo])
+    hist = reg.histogram("arena_test_seconds")
+    for _ in range(80):
+        hist.record(0.01)
+    for _ in range(20):
+        hist.record(5.0)
+    out = eng.evaluate()
+    obj = out["objectives"]["read-latency"]
+    # 20% of requests blew the threshold against a 10% budget: burn 2.
+    assert obj["error_frac_fast"] == pytest.approx(0.2)
+    assert obj["burn_fast"] == pytest.approx(2.0)
+    assert obj["state"] == "ok"  # 2.0 < 14.4: slow, not page-worthy
+
+
+# --- transitions, events, exemplars ----------------------------------------
+
+
+def test_transitions_post_events_with_resolvable_exemplar():
+    """ok->firing and firing->ok are edge-triggered `slo_alert` events
+    (exactly one each, not one per evaluate), the firing record carries
+    the exemplar trace id of the offending histogram bucket, and
+    `alerts_fired` stays sticky after recovery."""
+    obs = obs_pkg.Observability()
+    slo = AVAIL(exemplar=Selector("arena_test_magnitude"))
+    reg, clock, win, eng = make_engine([slo], obs=obs)
+    good = obs.counter("arena_test_good_total")
+    bad = obs.counter("arena_test_bad_total")
+    mag = obs.histogram("arena_test_magnitude", base=1.0)
+
+    good.inc(1000)
+    eng.evaluate()
+    # The incident, with the exemplar recorded the way the front door
+    # records shed magnitudes: the offending batch's own trace id.
+    mag.record(4096.0, trace_id=77)
+    bad.inc(5000)
+    eng.evaluate()
+    eng.evaluate()  # still firing: NO second event (edge, not level)
+
+    alerts = [e for e in obs.events if e["kind"] == "slo_alert"]
+    assert len(alerts) == 1
+    assert alerts[0]["slo"] == "deliver"
+    assert alerts[0]["state"] == "firing"
+    assert alerts[0]["trace_id"] == 77
+    assert eng.firings("deliver")[-1]["trace_id"] == 77
+
+    # Recovery: rotate the incident out of both windows entirely.
+    for _ in range(13):
+        clock.tick(5.0)
+        win.advance()
+    out = eng.evaluate()
+    assert out["objectives"]["deliver"]["state"] == "ok"
+    alerts = [e for e in obs.events if e["kind"] == "slo_alert"]
+    assert len(alerts) == 2
+    assert alerts[1]["state"] == "ok"
+    assert eng.alerts_fired() == 1  # sticky: the page happened
+    assert out["alerts_active"] == 0
+
+
+def test_default_slos_cover_the_serving_tier():
+    names = {s.name for s in default_slos()}
+    assert names == {
+        "wire-availability", "wire-read-latency", "submit-delivery"
+    }
+    for s in default_slos():
+        assert s.burn_threshold == DEFAULT_BURN_THRESHOLD
+        payload = s.to_payload()
+        assert payload["name"] == s.name
+        assert payload["kind"] in ("availability", "latency")
+
+
+def test_malformed_slos_are_rejected():
+    with pytest.raises(SLOError):
+        SLO("x", target=1.5, good=Selector("g"), bad=Selector("b"))
+    with pytest.raises(SLOError):
+        SLO("x", target=0.9)  # neither kind declared
+    with pytest.raises(SLOError):
+        SLO("x", target=0.9, latency=Selector("l"))  # no threshold_s
+    with pytest.raises(SLOError):
+        SLOEngine(object(), slos=[AVAIL(), AVAIL()])  # duplicate names
+
+
+def test_null_engine_is_a_true_noop_twin():
+    null = NullSLOEngine()
+    out = null.evaluate()
+    assert out["objectives"] == {}
+    assert out["alerts_active"] == 0
+    assert null.alerts_fired() == 0
+    assert null.firings() == []
+
+
+# --- the stats() wiring ----------------------------------------------------
+
+
+def test_server_stats_embeds_the_slo_block():
+    """`ArenaServer.stats()` carries one live SLO evaluation with
+    window/profiler health folded in — the operator's one-stop
+    am-I-okay read (and the /debug/slo payload's source of truth)."""
+    obs = obs_pkg.Observability()
+    srv = ArenaServer(num_players=8, obs=obs)
+    try:
+        block = srv.stats()["slo"]
+        assert set(block["objectives"]) == {
+            "wire-availability", "wire-read-latency", "submit-delivery"
+        }
+        assert block["alerts_active"] == 0
+        assert block["errors"] == []
+        assert block["healthy"] is True
+        assert block["window_health"]["error"] is None
+        assert block["profiler_health"]["error"] is None
+    finally:
+        srv.close()

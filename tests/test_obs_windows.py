@@ -1,0 +1,327 @@
+"""Sliding-window aggregation contracts (arena/obs/windows.py).
+
+The load-bearing properties:
+
+- the ring ROTATES: a full-window read diffs against the OLDEST
+  retained boundary, so counts recorded across multiple intervals all
+  land in the window — the mutation audit carries a
+  window-ring-never-rotates mutant (head frozen in place, so the ring
+  holds only the newest boundary and every "window" collapses to the
+  last interval); test_window_merges_counts_across_ring_intervals is
+  its named kill;
+- wraparound exactness: past `intervals` rotations the oldest history
+  EXPIRES — the window is a window, not a second cumulative store;
+- windowed quantiles agree with offline numpy over the same sample
+  set to within one log2 bucket, across rotation and wraparound (the
+  property the /debug/window p99 is trusted to have);
+- windowed counter deltas are EXACT under N-thread concurrency (the
+  same no-lost-updates discipline the cumulative registry pins);
+- PR 10 liveness: a dead rotation thread is an explicit WindowError
+  on every blocked wait and a non-None health()["error"] — never a
+  silently frozen window.
+
+All fake-clock driven (no sleeps on the rotation math); only the
+liveness tests start the real thread.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from arena.obs.metrics import Registry
+from arena.obs.windows import NullWindow, SlidingWindow, WindowError
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def make_window(intervals=12, interval_s=5.0):
+    reg = Registry()
+    clock = FakeClock()
+    win = SlidingWindow(
+        reg, intervals=intervals, interval_s=interval_s, clock=clock
+    )
+    return reg, clock, win
+
+
+# --- rotation correctness (the mutation-audit kill) ------------------------
+
+
+def test_window_merges_counts_across_ring_intervals():
+    """Counts recorded in DIFFERENT intervals all land in the full
+    window: the read diffs against the oldest retained boundary, not
+    the newest. Named kill for the audit's window-ring-never-rotates
+    mutant (head frozen => ring[head] holds the NEWEST boundary and
+    the 'full window' collapses to just the last interval)."""
+    reg, clock, win = make_window(intervals=12, interval_s=5.0)
+    c = reg.counter("arena_test_total")
+
+    c.inc(10)
+    clock.tick(5.0)
+    assert win.advance() == 1
+    c.inc(20)
+    clock.tick(5.0)
+    assert win.advance() == 1
+    c.inc(30)
+
+    full = win.delta()
+    assert full.counter_delta("arena_test_total") == 60
+    # The fast window (1 interval back) sees only the newest records.
+    fast = win.delta(intervals=1)
+    assert fast.counter_delta("arena_test_total") == 30
+    assert win.health()["rotations"] == 2
+
+
+def test_window_expires_history_past_the_ring():
+    """After `intervals` further rotations with no new traffic, old
+    counts leave the window entirely: a window, not a cumulative."""
+    reg, clock, win = make_window(intervals=4, interval_s=1.0)
+    c = reg.counter("arena_test_total")
+    c.inc(100)
+    for _ in range(5):
+        clock.tick(1.0)
+        win.advance()
+    assert win.delta().counter_delta("arena_test_total") == 0
+    # The cumulative registry still has everything (windows are reads,
+    # never mutations of the underlying store).
+    assert c.value == 100
+
+
+def test_window_wraparound_is_exact():
+    """Across many wraparounds the full window equals exactly the sum
+    of the last `intervals` completed intervals plus the current
+    partial one."""
+    intervals = 4
+    reg, clock, win = make_window(intervals=intervals, interval_s=1.0)
+    c = reg.counter("arena_test_total")
+    per_interval = []
+    for k in range(11):
+        c.inc(k + 1)
+        per_interval.append(k + 1)
+        clock.tick(1.0)
+        win.advance()
+        # Right after rotation r the window diffs against the boundary
+        # `intervals` rotations back: seed (=everything) while the ring
+        # is still filling, then exactly the last intervals-1 completed
+        # intervals (the in-progress interval is empty here).
+        rotations = k + 1
+        expect = (
+            sum(per_interval)
+            if rotations <= intervals - 1
+            else sum(per_interval[-(intervals - 1):])
+        )
+        assert win.delta().counter_delta("arena_test_total") == expect
+    # Mid-interval partial rides on top of the completed spans.
+    c.inc(1000)
+    assert win.delta().counter_delta("arena_test_total") == (
+        sum(per_interval[-(intervals - 1):]) + 1000
+    )
+
+
+def test_multi_interval_clock_jump_rotates_every_crossed_boundary():
+    """A clock jump over n boundaries rotates n slots (capped at the
+    ring) in ONE advance — a stalled reader catching up must expire
+    history exactly as if it had rotated on time."""
+    reg, clock, win = make_window(intervals=4, interval_s=1.0)
+    c = reg.counter("arena_test_total")
+    c.inc(7)
+    clock.tick(2.5)  # crosses 2 boundaries at once
+    assert win.advance() == 2
+    assert win.health()["rotations"] == 2
+    assert win.delta().counter_delta("arena_test_total") == 7
+    clock.tick(10.0)  # way past the whole ring
+    win.advance()
+    assert win.delta().counter_delta("arena_test_total") == 0
+
+
+# --- windowed quantiles vs offline numpy -----------------------------------
+
+
+def test_windowed_percentile_matches_numpy_within_one_bucket():
+    """Property: across rotation and wraparound, the windowed p50/p90/
+    p99 land within one log2 bucket of the offline numpy percentile
+    computed over exactly the samples still in the window."""
+    reg = Registry()
+    clock = FakeClock()
+    intervals, interval_s = 4, 1.0
+    win = SlidingWindow(
+        reg, intervals=intervals, interval_s=interval_s, clock=clock
+    )
+    hist = reg.histogram("arena_test_seconds", base=1.0)
+    rng = np.random.default_rng(7)
+    interval_samples = [[]]  # newest last; [-1] is the current partial
+    for step in range(10):
+        vals = rng.lognormal(mean=2.0, sigma=1.5, size=200)
+        for v in vals:
+            hist.record(float(v))
+            interval_samples[-1].append(float(v))
+        # Window = everything while the ring is still filling, then the
+        # last intervals-1 completed chunks + the current partial one.
+        rotations = step
+        live = (
+            interval_samples
+            if rotations <= intervals - 1
+            else interval_samples[-intervals:]
+        )
+        in_window = np.asarray([v for chunk in live for v in chunk])
+        wh = win.delta().histogram("arena_test_seconds")
+        assert wh.count == in_window.size
+        for q in (0.5, 0.9, 0.99):
+            got = wh.percentile(q)
+            ref = float(np.percentile(in_window, q * 100))
+            idx_got = int(np.searchsorted(hist.bounds, got, side="left"))
+            idx_ref = int(np.searchsorted(hist.bounds, ref, side="left"))
+            assert abs(idx_got - idx_ref) <= 1, (
+                f"step {step} q={q}: windowed {got} vs numpy {ref} "
+                f"(buckets {idx_got} vs {idx_ref})"
+            )
+        clock.tick(interval_s)
+        win.advance()
+        interval_samples.append([])
+
+
+def test_windowed_histogram_sum_and_rate():
+    reg, clock, win = make_window(intervals=3, interval_s=2.0)
+    hist = reg.histogram("arena_test_seconds", base=1.0)
+    for v in (1.0, 2.0, 3.0):
+        hist.record(v)
+    clock.tick(2.0)
+    win.advance()
+    hist.record(10.0)
+    wh = win.delta().histogram("arena_test_seconds")
+    assert wh.count == 4
+    assert wh.sum == pytest.approx(16.0)
+    # Rate over the window's elapsed span (2 completed + 0 partial s).
+    assert wh.rate_per_s == pytest.approx(4 / wh.elapsed_s)
+
+
+# --- exactness under concurrency -------------------------------------------
+
+
+def test_windowed_counter_is_exact_under_n_threads():
+    """8 threads x 500 increments with rotations interleaved lose
+    NOTHING: the full-window delta equals the arithmetic total (the
+    window must inherit the registry's exactness, not sample it)."""
+    reg, clock, win = make_window(intervals=12, interval_s=5.0)
+    c = reg.counter("arena_test_total")
+    threads, per_thread = 8, 500
+    barrier = threading.Barrier(threads)
+
+    def worker():
+        barrier.wait()
+        for _ in range(per_thread):
+            c.inc()
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    # Rotate a few times while the writers hammer (fewer rotations
+    # than the ring holds, so nothing expires).
+    for _ in range(3):
+        clock.tick(5.0)
+        win.advance()
+    for t in ts:
+        t.join()
+    assert win.delta().counter_delta("arena_test_total") == (
+        threads * per_thread
+    )
+
+
+# --- reads, payloads, twins ------------------------------------------------
+
+
+def test_read_payload_shape_and_label_match():
+    reg, clock, win = make_window(intervals=2, interval_s=1.0)
+    reg.counter("arena_test_total", endpoint="a").inc(3)
+    reg.counter("arena_test_total", endpoint="b").inc(4)
+    reg.gauge("arena_test_depth").set(9)
+    out = win.read()
+    assert set(out) == {
+        "window_s", "counters", "gauges", "histograms", "ring"
+    }
+    assert out["counters"]['arena_test_total{endpoint="a"}']["delta"] == 3
+    assert out["gauges"]["arena_test_depth"] == 9
+    assert out["ring"]["mode"] == "on-read"
+    assert out["ring"]["error"] is None
+    # Label matching merges across series; prefix patterns match too.
+    d = win.delta()
+    assert d.counter_delta("arena_test_total") == 7
+    assert d.counter_delta("arena_test_total", {"endpoint": "a"}) == 3
+    assert d.counter_delta("arena_test_total", {"endpoint": "*"}) == 7
+
+
+def test_null_window_is_a_true_noop_twin():
+    null = NullWindow()
+    assert null.start() is null
+    assert null.advance() == 0
+    assert null.delta().counter_delta("anything") == 0
+    assert null.delta().histogram("anything").count == 0
+    assert null.read()["ring"]["error"] is None
+    assert null.wait_for_rotation() == 0
+    null.close()
+
+
+def test_window_rejects_malformed_shape():
+    reg = Registry()
+    with pytest.raises(WindowError):
+        SlidingWindow(reg, intervals=0)
+    with pytest.raises(WindowError):
+        SlidingWindow(reg, interval_s=0.0)
+
+
+# --- PR 10 liveness discipline ---------------------------------------------
+
+
+def test_rotation_thread_rotates_for_real():
+    reg = Registry()
+    win = SlidingWindow(reg, intervals=4, interval_s=0.02)
+    win.start()
+    try:
+        assert win.wait_for_rotation(rotations=2, timeout=10.0) >= 2
+        assert win.health()["mode"] == "thread"
+        assert win.health()["error"] is None
+    finally:
+        win.close()
+    # A clean close is NOT an error; reads continue in on-read mode.
+    assert win.health()["error"] is None
+    assert win.health()["mode"] == "on-read"
+    # And start() is a restart, not a one-shot.
+    win.start()
+    try:
+        win.wait_for_rotation(rotations=1, timeout=10.0)
+    finally:
+        win.close()
+
+
+def test_dead_rotator_raises_instead_of_hanging():
+    """PR 10 discipline: a rotation thread that died mid-run surfaces
+    as an explicit WindowError from every blocked wait and a non-None
+    health error — never a silently frozen window."""
+    reg = Registry()
+    win = SlidingWindow(reg, intervals=4, interval_s=0.01)
+
+    def boom():
+        raise RuntimeError("snapshot exploded")
+
+    win._snap_cumulative = boom  # instance shadow: next rotation dies
+    win.start()
+    with pytest.raises(WindowError, match="rotation thread died"):
+        win.wait_for_rotation(rotations=1, timeout=10.0)
+    health = win.health()
+    assert health["error"] is not None
+    assert "snapshot exploded" in health["error"]
+
+
+def test_wait_for_rotation_without_thread_is_an_error():
+    reg, _clock, win = make_window()
+    with pytest.raises(WindowError, match="no rotation thread"):
+        win.wait_for_rotation(timeout=0.2)
