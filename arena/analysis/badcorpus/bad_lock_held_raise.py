@@ -1,0 +1,18 @@
+"""jaxlint corpus: a manual lock pairing escaped by a raise.
+
+`update_totals` spells `acquire()`/`release()` by hand — the shape
+`with _lock:` would have scoped — and the subscript between them can
+raise KeyError. On that path the function unwinds with the lock HELD:
+every later caller deadlocks on a lock whose owner is long gone. The
+PR 10 lock rules only see with-held locks; this is the manual-pairing
+gap they left open. Rule: lock-held-across-raise."""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def update_totals(totals, key, delta):
+    _lock.acquire()
+    totals[key] = totals[key] + delta  # KeyError unwinds with the lock held
+    _lock.release()

@@ -1,0 +1,26 @@
+"""jaxlint corpus: the release exists — but only on the happy path.
+
+`serve_one` pairs its `stage()` with a `release()`, so the author knew
+the protocol; the pairing only holds on fall-through. The wire call
+between the two can raise, and on that path the slot stays in flight
+forever — the release belongs in a finally (or the whole pair behind a
+context manager). Rule: missing-finally-for-paired-call."""
+
+
+class StagedBuffer:  # protocol: stage->release
+    def __init__(self):
+        self._in_flight = 0
+
+    def stage(self, batch):
+        self._in_flight += 1
+        return batch
+
+    def release(self):
+        self._in_flight -= 1
+
+
+def serve_one(batch, wire):
+    buf = StagedBuffer()
+    buf.stage(batch)
+    wire.send(batch)  # a raise here skips the release below
+    buf.release()

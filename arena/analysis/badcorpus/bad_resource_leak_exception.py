@@ -1,0 +1,29 @@
+"""jaxlint corpus: acquired resource with no release on any path.
+
+`StagedBuffer` declares the `stage->release` protocol on its class
+header; `pack_and_send` stages a slot and then hands the batch to the
+wire — a call that can raise — without EVER releasing. Both the normal
+exit and every exceptional exit leak the slot, and with the in-flight
+marker set nothing downstream can retire it: the next stage() of this
+bucket stalls forever. Rule: resource-leaked-on-exception."""
+
+
+class StagedBuffer:  # protocol: stage->release
+    """Double-buffered staging slots, PR 4 shape: stage marks a slot
+    in flight, release() retires the oldest."""
+
+    def __init__(self):
+        self._in_flight = 0
+
+    def stage(self, batch):
+        self._in_flight += 1
+        return batch
+
+    def release(self):
+        self._in_flight -= 1
+
+
+def pack_and_send(batch, wire):
+    buf = StagedBuffer()
+    buf.stage(batch)
+    wire.send(batch)  # can raise — and nobody ever releases the slot

@@ -1,0 +1,26 @@
+"""jaxlint corpus: calling into an object after its terminal method.
+
+`Feed` declares `# protocol: close` — close() is the end of the
+object's life (threads joined, buffers dropped). `shutdown_and_flush`
+closes the feed and then polls it, exactly the shape that turns into a
+silent no-op or an attribute error at 3am depending on which fields
+close() tore down. Rule: use-after-close."""
+
+
+class Feed:  # protocol: close
+    """A poll-able source whose close() drops the underlying buffer."""
+
+    def __init__(self):
+        self._buffer = []
+
+    def poll(self):
+        return self._buffer.pop() if self._buffer else None
+
+    def close(self):
+        self._buffer = None
+
+
+def shutdown_and_flush(sink):
+    feed = Feed()
+    feed.close()
+    sink.write(feed.poll())  # the feed is dead: poll() after close()

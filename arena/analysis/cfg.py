@@ -1,0 +1,386 @@
+"""Per-function intraprocedural CFG with exception edges (jaxlint v4).
+
+jaxlint v1–v3 reason over normal control flow only; the bug class this
+module unlocks is *exceptional-path* state corruption — a staging slot
+never released after a failed dispatch, a lock held across a raise.
+`build_cfg(fn_node)` turns one `ast.FunctionDef` into a statement-level
+graph where EVERY raise-capable statement carries an exception edge to
+wherever an exception actually goes: the enclosing handler dispatch,
+through each enclosing `finally` copy, or the function's synthetic
+raise-exit.
+
+Model (deliberately simple, deliberately honest):
+
+- One node per statement, plus synthetic nodes: ``entry``, ``exit``
+  (normal return), ``raise-exit`` (unwound out of the function),
+  ``join`` (loop exits / try fall-through / handler dispatch), and
+  ``with-unwind`` (the ``__exit__``-on-unwind call a `with` guarantees).
+- Edges are ``(successor_index, kind)`` with kind ``"normal"`` or
+  ``"exception"``. An exception edge leaves the statement that raised;
+  the typestate analyzer treats the two kinds differently (a call that
+  raises never completed, so its acquire never happened).
+- ``finally`` is modeled by DUPLICATION: one copy of the finalbody per
+  distinct continuation (fall-through, each return/break/continue
+  route, exception propagation), memoized per target. That is what
+  makes "the release sits in a finally" visibly dominate both edge
+  kinds — the property the CFG tests pin.
+- ``try/except``: exception edges from body statements go to a single
+  handler-dispatch join, which fans out to every handler; unless some
+  handler is a catch-all (bare ``except`` / ``Exception`` /
+  ``BaseException``), the dispatch also keeps an unmatched path to the
+  enclosing frame. ``else`` and handler bodies propagate OUTWARD (their
+  exceptions are not caught by this try's handlers).
+- ``with``: body exceptions route through a synthetic with-unwind node
+  (``__exit__`` runs) before propagating. Abrupt normal exits (return
+  out of a `with`) take the plain frame route — `with` cleanup on the
+  normal path is PR 10's lock analyzer's territory; this module is
+  about the exceptional one.
+- Raise-capability is syntactic: a statement whose own expressions
+  contain a call, subscript, binary op, raise, or assert can raise;
+  `for`/`with` headers always can (iterator/context protocol). Plain
+  name/attribute reads are deemed safe — the linter is heuristic and
+  tuned so the clean tree stays clean.
+
+No new dependencies: stdlib `ast` only, and no imports from the rest
+of the analysis package — `lifecycle.py` builds on top of this, never
+the other way around.
+"""
+
+from __future__ import annotations
+
+import ast
+
+EDGE_NORMAL = "normal"
+EDGE_EXC = "exception"
+
+# Node kinds.
+K_ENTRY = "entry"
+K_EXIT = "exit"
+K_RAISE = "raise-exit"
+K_STMT = "stmt"
+K_JOIN = "join"
+K_WITH_UNWIND = "with-unwind"
+
+_RAISING_EXPRS = (ast.Call, ast.Subscript, ast.BinOp)
+
+
+def stmt_can_raise(stmt) -> bool:
+    """Can this statement's OWN evaluation raise? (Headers only for
+    compound statements — their bodies are separate nodes.)"""
+    if isinstance(stmt, (ast.Raise, ast.Assert)):
+        return True
+    if isinstance(stmt, (ast.For, ast.AsyncFor, ast.With, ast.AsyncWith)):
+        return True  # iterator / context-manager protocol calls
+    if isinstance(stmt, ast.Match):
+        return True  # subject evaluation + pattern/guard machinery
+    for expr in _own_exprs(stmt):
+        for node in ast.walk(expr):
+            if isinstance(node, _RAISING_EXPRS):
+                return True
+    return False
+
+
+_STMT_LIST_FIELDS = ("body", "orelse", "finalbody", "handlers", "cases")
+
+
+def _own_exprs(stmt):
+    """A statement's own expression roots (header expressions for
+    compound statements), excluding nested statement lists."""
+    for field, value in ast.iter_fields(stmt):
+        if field in _STMT_LIST_FIELDS:
+            continue
+        if isinstance(value, ast.AST):
+            yield value
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.AST):
+                    yield v
+
+
+class CFGNode:
+    __slots__ = ("idx", "kind", "stmt", "raise_capable", "succs")
+
+    def __init__(self, idx, kind, stmt=None, raise_capable=False):
+        self.idx = idx
+        self.kind = kind
+        self.stmt = stmt  # the ast statement (or handler) this models
+        self.raise_capable = raise_capable
+        self.succs = []  # [(successor idx, edge kind), ...]
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        line = getattr(self.stmt, "lineno", "-")
+        return f"<CFGNode {self.idx} {self.kind} line={line} succs={self.succs}>"
+
+
+class CFG:
+    """One function's graph. `nodes[entry_idx]` / `exit_idx` /
+    `raise_idx` are the synthetic endpoints; statement nodes map back
+    to their ast node via `.stmt` (finally duplication means one
+    statement may own several nodes)."""
+
+    def __init__(self, fn_node):
+        self.fn = fn_node
+        self.nodes = []
+        self.entry_idx = self._add(K_ENTRY)
+        self.exit_idx = self._add(K_EXIT)
+        self.raise_idx = self._add(K_RAISE)
+
+    def _add(self, kind, stmt=None, raise_capable=False) -> int:
+        node = CFGNode(len(self.nodes), kind, stmt, raise_capable)
+        self.nodes.append(node)
+        return node.idx
+
+    def _edge(self, src, dst, kind):
+        if (dst, kind) not in self.nodes[src].succs:
+            self.nodes[src].succs.append((dst, kind))
+
+    def stmt_nodes(self, stmt):
+        """Every node modeling `stmt` (≥2 when finally duplication or
+        handler fanning copied it)."""
+        return [n for n in self.nodes if n.stmt is stmt]
+
+    def reachable_from(self, start_idx) -> set:
+        seen = {start_idx}
+        stack = [start_idx]
+        while stack:
+            for succ, _kind in self.nodes[stack.pop()].succs:
+                if succ not in seen:
+                    seen.add(succ)
+                    stack.append(succ)
+        return seen
+
+
+class _Frame:
+    """Where abrupt exits go from the current nesting level: exceptions
+    (`exc`), `return` (`ret`), `break` (`brk`), `continue` (`cont`) —
+    each already routed through any enclosing finally copies."""
+
+    __slots__ = ("exc", "ret", "brk", "cont")
+
+    def __init__(self, exc, ret, brk=None, cont=None):
+        self.exc = exc
+        self.ret = ret
+        self.brk = brk
+        self.cont = cont
+
+    def replaced(self, **kw):
+        out = _Frame(self.exc, self.ret, self.brk, self.cont)
+        for k, v in kw.items():
+            setattr(out, k, v)
+        return out
+
+
+_CATCH_ALL_TAILS = ("Exception", "BaseException")
+
+
+def _handler_is_catch_all(handler) -> bool:
+    if handler.type is None:
+        return True
+    exprs = (
+        handler.type.elts if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    for expr in exprs:
+        name = _dotted(expr)
+        if name and name.split(".")[-1] in _CATCH_ALL_TAILS:
+            return True
+    return False
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Builder:
+    def __init__(self, fn_node):
+        self.cfg = CFG(fn_node)
+
+    def build(self):
+        cfg = self.cfg
+        frame = _Frame(exc=cfg.raise_idx, ret=cfg.exit_idx)
+        entry, dangling = self._block(cfg.fn.body, frame)
+        cfg._edge(cfg.entry_idx, entry if entry is not None else cfg.exit_idx,
+                  EDGE_NORMAL)
+        for d in dangling:
+            cfg._edge(d, cfg.exit_idx, EDGE_NORMAL)
+        return cfg
+
+    def _block(self, stmts, frame):
+        """(entry idx or None, dangling fall-through node idxs)."""
+        entry = None
+        dangling = []
+        for stmt in stmts:
+            s_entry, s_dangling = self._stmt(stmt, frame)
+            if entry is None:
+                entry = s_entry
+            for d in dangling:
+                self.cfg._edge(d, s_entry, EDGE_NORMAL)
+            dangling = s_dangling
+            if not dangling:
+                break  # everything after an unconditional exit is dead
+        return entry, dangling
+
+    def _simple(self, stmt, frame):
+        """One node; exception edge iff the statement can raise. This
+        is the single point every raise-capable statement passes
+        through — the exception edge below is THE edge the CFG property
+        tests (and the exception-edge-dropped mutant) police."""
+        can_raise = stmt_can_raise(stmt)
+        idx = self.cfg._add(K_STMT, stmt, can_raise)
+        if can_raise:
+            self.cfg._edge(idx, frame.exc, EDGE_EXC)
+        return idx
+
+    def _stmt(self, stmt, frame):
+        cfg = self.cfg
+        if isinstance(stmt, ast.Return):
+            idx = self._simple(stmt, frame)
+            cfg._edge(idx, frame.ret, EDGE_NORMAL)
+            return idx, []
+        if isinstance(stmt, ast.Raise):
+            idx = self._simple(stmt, frame)
+            return idx, []
+        if isinstance(stmt, ast.Break):
+            idx = self._simple(stmt, frame)
+            if frame.brk is not None:
+                cfg._edge(idx, frame.brk, EDGE_NORMAL)
+            return idx, []
+        if isinstance(stmt, ast.Continue):
+            idx = self._simple(stmt, frame)
+            if frame.cont is not None:
+                cfg._edge(idx, frame.cont, EDGE_NORMAL)
+            return idx, []
+        if isinstance(stmt, ast.If):
+            header = self._simple(stmt, frame)
+            b_entry, b_dangling = self._block(stmt.body, frame)
+            cfg._edge(header, b_entry, EDGE_NORMAL)
+            dangling = list(b_dangling)
+            if stmt.orelse:
+                o_entry, o_dangling = self._block(stmt.orelse, frame)
+                cfg._edge(header, o_entry, EDGE_NORMAL)
+                dangling += o_dangling
+            else:
+                dangling.append(header)  # test-false falls through
+            return header, dangling
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, frame)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, frame)
+        if isinstance(stmt, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+            return self._try(stmt, frame)
+        if isinstance(stmt, ast.Match):
+            return self._match(stmt, frame)
+        # Simple statements (and nested defs/classes, whose bodies are
+        # separate scopes the analyzer visits on their own).
+        idx = self._simple(stmt, frame)
+        return idx, [idx]
+
+    def _loop(self, stmt, frame):
+        cfg = self.cfg
+        header = self._simple(stmt, frame)
+        after = cfg._add(K_JOIN)
+        body_frame = frame.replaced(brk=after, cont=header)
+        b_entry, b_dangling = self._block(stmt.body, body_frame)
+        cfg._edge(header, b_entry, EDGE_NORMAL)
+        for d in b_dangling:
+            cfg._edge(d, header, EDGE_NORMAL)  # back edge
+        if stmt.orelse:
+            o_entry, o_dangling = self._block(stmt.orelse, frame)
+            cfg._edge(header, o_entry, EDGE_NORMAL)
+            for d in o_dangling:
+                cfg._edge(d, after, EDGE_NORMAL)
+        else:
+            cfg._edge(header, after, EDGE_NORMAL)
+        return header, [after]
+
+    def _with(self, stmt, frame):
+        cfg = self.cfg
+        header = self._simple(stmt, frame)
+        unwind = cfg._add(K_WITH_UNWIND, stmt)
+        cfg._edge(unwind, frame.exc, EDGE_EXC)
+        body_frame = frame.replaced(exc=unwind)
+        b_entry, b_dangling = self._block(stmt.body, body_frame)
+        cfg._edge(header, b_entry, EDGE_NORMAL)
+        return header, list(b_dangling)
+
+    def _try(self, stmt, frame):
+        cfg = self.cfg
+        after = cfg._add(K_JOIN)
+        fin_memo = {}
+
+        def fin(target):
+            """Entry of the finally copy continuing to `target` (or
+            `target` itself when there is no finalbody)."""
+            if not stmt.finalbody:
+                return target
+            if target not in fin_memo:
+                # The copy is built against the OUTER frame: a raise or
+                # return inside a finalbody propagates outward (through
+                # any enclosing finallies), never back into this one.
+                f_entry, f_dangling = self._block(stmt.finalbody, frame)
+                fin_memo[target] = f_entry
+                for d in f_dangling:
+                    cfg._edge(d, target, EDGE_NORMAL)
+            return fin_memo[target]
+
+        # The frame for code whose exceptions are NOT caught here but
+        # still run the finally: else-clauses, handler bodies, and the
+        # body of a finally-only try.
+        outward = _Frame(
+            exc=fin(frame.exc),
+            ret=fin(frame.ret),
+            brk=fin(frame.brk) if frame.brk is not None else None,
+            cont=fin(frame.cont) if frame.cont is not None else None,
+        )
+
+        if stmt.handlers:
+            dispatch = cfg._add(K_JOIN)
+            for handler in stmt.handlers:
+                h_node = cfg._add(K_STMT, handler)
+                cfg._edge(dispatch, h_node, EDGE_NORMAL)
+                h_entry, h_dangling = self._block(handler.body, outward)
+                cfg._edge(h_node, h_entry, EDGE_NORMAL)
+                for d in h_dangling:
+                    cfg._edge(d, fin(after), EDGE_NORMAL)
+            if not any(_handler_is_catch_all(h) for h in stmt.handlers):
+                cfg._edge(dispatch, fin(frame.exc), EDGE_NORMAL)
+            body_exc = dispatch
+        else:
+            body_exc = outward.exc
+
+        body_frame = _Frame(exc=body_exc, ret=outward.ret,
+                            brk=outward.brk, cont=outward.cont)
+        b_entry, b_dangling = self._block(stmt.body, body_frame)
+        if stmt.orelse:
+            o_entry, o_dangling = self._block(stmt.orelse, outward)
+            for d in b_dangling:
+                cfg._edge(d, o_entry, EDGE_NORMAL)
+            b_dangling = o_dangling
+        for d in b_dangling:
+            cfg._edge(d, fin(after), EDGE_NORMAL)
+        if b_entry is None:  # empty body cannot parse, but stay total
+            b_entry = fin(after)
+        return b_entry, [after]
+
+    def _match(self, stmt, frame):
+        cfg = self.cfg
+        header = self._simple(stmt, frame)
+        dangling = [header]  # no case matched
+        for case in stmt.cases:
+            c_entry, c_dangling = self._block(case.body, frame)
+            cfg._edge(header, c_entry, EDGE_NORMAL)
+            dangling += c_dangling
+        return header, dangling
+
+
+def build_cfg(fn_node) -> CFG:
+    """The CFG of one `ast.FunctionDef` / `ast.AsyncFunctionDef` body.
+    Nested defs/classes appear as single opaque statements."""
+    return _Builder(fn_node).build()

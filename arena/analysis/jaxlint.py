@@ -1044,6 +1044,59 @@ def _json_line(finding: Finding) -> str:
     }, sort_keys=True)
 
 
+def _sarif_report(findings) -> str:
+    """Minimal SARIF 2.1.0 document: rule id + severity level + one
+    physical location + message text per finding, rule descriptors for
+    every rule referenced. Enough for standard CI tooling to annotate
+    PRs; nothing speculative beyond that. Suppressed findings carry an
+    inSource suppression object (the SARIF spelling of the JSON
+    format's `suppressed: true`)."""
+    rule_ids = sorted({f.rule for f in findings})
+    descriptors = []
+    for rid in rule_ids:
+        r = RULES.get(rid)
+        descriptors.append({
+            "id": rid,
+            "shortDescription": {
+                "text": r.summary if r is not None else "synthetic finding",
+            },
+        })
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": finding_severity(f),  # SEVERITIES ⊂ SARIF levels
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": f.col + 1,  # SARIF is 1-based
+                    },
+                },
+            }],
+        }
+        if f.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        results.append(result)
+    return json.dumps({
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": "jaxlint", "rules": descriptors}},
+            "results": results,
+        }],
+    }, sort_keys=True)
+
+
+def baseline_key(finding: Finding) -> str:
+    """The identity a baseline entry pins: rule + path + message —
+    deliberately NOT the line, so unrelated edits that drift a known
+    finding up or down the file don't resurrect it."""
+    return f"{finding.rule}::{finding.path}::{finding.message}"
+
+
 def _parse_rule_list(raw):
     return [name.strip() for name in raw.split(",") if name.strip()]
 
@@ -1075,11 +1128,19 @@ def main(argv=None) -> int:
         "given). Exit-code semantics unchanged.",
     )
     parser.add_argument(
-        "--format", choices=("human", "json"), default="human",
+        "--format", choices=("human", "json", "sarif"), default="human",
         help="human (default): path:line:col: rule: message; json: one "
         "JSON object per finding per line (suppressed findings included, "
-        "flagged; severity carried). Exit codes are identical in both "
-        "formats.",
+        "flagged; severity carried); sarif: one SARIF 2.1.0 document on "
+        "stdout for CI annotation tooling. Exit codes are identical in "
+        "all formats.",
+    )
+    parser.add_argument(
+        "--baseline", metavar="FILE",
+        help="if FILE exists: report only findings NOT recorded in it "
+        "(keyed rule+path+message — tolerant of line drift). If FILE "
+        "does not exist: write the current findings to it and exit 0, "
+        "so a new rule can land on a dirty tree without flag-day fixes.",
     )
     args = parser.parse_args(argv)
     if args.list_rules:
@@ -1103,12 +1164,15 @@ def main(argv=None) -> int:
     targets = args.paths or default_targets()
     try:
         findings = lint_paths(
-            targets, keep_suppressed=(args.format == "json"), rules=selected
+            targets,
+            keep_suppressed=(args.format in ("json", "sarif")),
+            rules=selected,
         )
     except PathError as exc:
         # EVERY bad path gets its own line (rc 2 covers them all): a
         # long CI target list should not reveal its problems one
-        # rerun at a time.
+        # rerun at a time. (sarif has no per-error result shape worth
+        # inventing here — bad paths fall back to the human lines.)
         for path, detail in exc.errors:
             if args.format == "json":
                 print(json.dumps(
@@ -1121,10 +1185,44 @@ def main(argv=None) -> int:
     except FileNotFoundError as exc:
         print(f"jaxlint: {exc}", file=sys.stderr)
         return 2
+    if args.baseline is not None:
+        bl_path = pathlib.Path(args.baseline)
+        if bl_path.exists():
+            try:
+                known = json.loads(bl_path.read_text(encoding="utf-8"))
+                known = set(known["findings"])
+            except (OSError, ValueError, KeyError, TypeError) as exc:
+                print(
+                    f"jaxlint: --baseline {args.baseline}: not a baseline "
+                    f"file ({exc})",
+                    file=sys.stderr,
+                )
+                return 2
+            findings = [f for f in findings if baseline_key(f) not in known]
+        else:
+            # First run: record the dirty tree and succeed. Suppressed
+            # findings are already acknowledged in-source — recording
+            # them too would mask the suppression comment ever being
+            # removed.
+            keys = sorted(
+                {baseline_key(f) for f in findings if not f.suppressed}
+            )
+            bl_path.write_text(
+                json.dumps({"findings": keys}, indent=2) + "\n",
+                encoding="utf-8",
+            )
+            print(
+                f"jaxlint: baseline written: {len(keys)} finding key(s) "
+                f"-> {args.baseline}",
+                file=sys.stderr,
+            )
+            findings = [f for f in findings if f.suppressed]
     live = [f for f in findings if not f.suppressed]
     if args.format == "json":
         for f in findings:
             print(_json_line(f))
+    elif args.format == "sarif":
+        print(_sarif_report(findings))
     else:
         for f in live:
             print(f.format())
@@ -1142,6 +1240,7 @@ def main(argv=None) -> int:
 # either import order ends with all rules registered exactly once).
 from arena.analysis import concurrency as _concurrency  # noqa: E402,F401
 from arena.analysis import absint as _absint  # noqa: E402,F401
+from arena.analysis import lifecycle as _lifecycle  # noqa: E402,F401
 
 
 if __name__ == "__main__":

@@ -1,0 +1,771 @@
+"""Path-sensitive lifecycle/resource protocol analyzer (jaxlint v4).
+
+The `# protocol:` comment on a class header (see
+`arena.analysis.project.parse_protocols`) declares its resource
+protocol: `# protocol: stage->release` means every `stage()` call
+creates an obligation discharged by `release()`; `# protocol: close`
+means `close()` is terminal — method calls on the object after it are
+use-after-close. This module runs a typestate analysis over the
+exception-edge CFG (`arena.analysis.cfg`) for every function, tracking
+obligations and terminal states along BOTH edge kinds, and registers
+four rules on the result:
+
+- ``resource-leaked-on-exception``: an obligation reaches function
+  exit (normal or exceptional) with no release and no ownership
+  transfer (returned / yielded / stored on self).
+- ``missing-finally-for-paired-call``: the function DOES release, but
+  only on the fall-through path — an exception between acquire and
+  release leaks. (The release-in-a-finally shape is clean because the
+  finally copy sits on both edge kinds.)
+- ``lock-held-across-raise``: a manual ``lock.acquire()`` (the kind
+  `with` would have scoped) escaped by a raise before ``release()``.
+  Composes with PR 10's lock rules, which see `with`-held locks only.
+- ``use-after-close``: a method call on an object on some path after
+  its terminal lifecycle method.
+
+Semantics that keep the clean tree clean (and honest):
+
+- On an EXCEPTION edge the out-state applies releases/closes/kills but
+  never acquires: a call that raised never completed, so it acquired
+  nothing — and a `release()` line's own exception edge does not
+  un-release what the finally already handled.
+- Ownership transfer: an acquire under a `return`/`yield`, assigned to
+  a `self.` attribute, or bound to a name that escapes that way, is
+  the CALLER's obligation — not tracked here.
+- A class's own protocol methods (and `__enter__`/`__exit__`/
+  `__del__`) are exempt: the body of `close()` is precisely where
+  "unpaired" calls are the implementation.
+- One interprocedural hop (same depth as the lock-order and taint
+  analyzers): a release inside a same-class method or a same-module /
+  imported helper the symbol table resolves is credited at the call
+  site.
+
+Type binding is heuristic, like everything in jaxlint: `self` binds to
+the enclosing class; `self.attr = Ctor()` anywhere in the class binds
+the attribute; `name = Ctor()` / `name = self.attr` bind locals. A
+constructor name that resolves to nothing still TAIL-matches a
+protocol-declaring class if the tail is unique project-wide (covers
+dynamically-imported module handles like `self._ingest_mod.X(...)`).
+Untypeable receivers produce no events — no claim, no false positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+
+from arena.analysis.cfg import (
+    EDGE_NORMAL,
+    K_STMT,
+    build_cfg,
+)
+from arena.analysis.jaxlint import rule
+from arena.analysis.project import LOCK_FACTORY_TAILS, dotted
+
+RULE_LEAK = "resource-leaked-on-exception"
+RULE_USE_AFTER_CLOSE = "use-after-close"
+RULE_LOCK_RAISE = "lock-held-across-raise"
+RULE_MISSING_FINALLY = "missing-finally-for-paired-call"
+
+_RULE_NAMES = (RULE_LEAK, RULE_USE_AFTER_CLOSE, RULE_LOCK_RAISE,
+               RULE_MISSING_FINALLY)
+
+_ALWAYS_EXEMPT = {"__enter__", "__exit__", "__del__"}
+
+
+class _Obligation:
+    __slots__ = ("oid", "key", "cls", "acquire", "release", "node", "kind")
+
+    def __init__(self, oid, key, cls, acquire, release, node, kind):
+        self.oid = oid
+        self.key = key          # dotted receiver, e.g. "self._staging"
+        self.cls = cls          # ClassSymbols or None (locks)
+        self.acquire = acquire  # method name that opened it
+        self.release = release  # method name that discharges it
+        self.node = node        # the acquiring ast.Call
+        self.kind = kind        # "pair" | "lock"
+
+
+def _iter_functions(tree):
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from walk(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from walk(child, child)
+            else:
+                yield from walk(child, cls)
+
+    yield from walk(tree, None)
+
+
+def _scope_walk(scope):
+    """ast.walk confined to one scope (no nested defs/classes)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _release_methods(cls_sym) -> set:
+    return {b for _a, b in cls_sym.protocol_pairs}
+
+
+def _acquire_methods(cls_sym) -> dict:
+    return {a: b for a, b in cls_sym.protocol_pairs}
+
+
+def _target_keys(tgt):
+    """Dotted keys a binding target (re)binds — Tuple/List unpacked,
+    inner expressions NOT walked (so `self.x = ...` kills `self.x`,
+    never `self`)."""
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _target_keys(elt)
+        return
+    if isinstance(tgt, ast.Starred):
+        yield from _target_keys(tgt.value)
+        return
+    key = dotted(tgt)
+    if key is not None:
+        yield key
+
+
+def _eval_order_exprs(stmt):
+    """A statement's own expression roots in (approximate) evaluation
+    order — value before targets for assignments, header expressions
+    only for compound statements."""
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value]
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [e for e in (stmt.test, stmt.msg) if e is not None]
+    roots = []
+    for field, value in ast.iter_fields(stmt):
+        if field in ("body", "orelse", "finalbody", "handlers", "cases"):
+            continue
+        if isinstance(value, ast.AST):
+            roots.append(value)
+        elif isinstance(value, list):
+            roots.extend(v for v in value if isinstance(v, ast.AST))
+    return roots
+
+
+class _ModuleLifecycle:
+    """One module's lifecycle pass: per-function CFG + typestate
+    fixpoint, findings bucketed per rule."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.findings = {name: [] for name in _RULE_NAMES}
+        self._seen = set()
+        self._oid_counter = itertools.count()
+        self._attr_types_cache = {}
+        # Tail name -> [ClassSymbols] over every protocol-declaring
+        # class the project table can see (the ctor tail-match pool).
+        self._protocol_index = {}
+        mods = (ctx.project.modules.values() if ctx.project is not None
+                else [ctx.symbols])
+        for mod in mods:
+            for cls in mod.classes.values():
+                if cls.has_protocols():
+                    self._protocol_index.setdefault(cls.name, []).append(cls)
+
+    def run(self):
+        ctx = self.ctx
+        for fn_node, cls_node in _iter_functions(ctx.tree):
+            if ctx.is_traced_def(fn_node):
+                continue
+            self._analyze_function(fn_node, cls_node)
+        return self
+
+    # -- type binding -------------------------------------------------------
+
+    def _resolve_ctor(self, call):
+        """ClassSymbols the constructor call builds, or None."""
+        fname = dotted(call.func)
+        if not fname:
+            return None
+        sym = self.ctx.symbols
+        if fname in sym.classes:
+            return sym.classes[fname]
+        project = self.ctx.project
+        parts = fname.split(".")
+        if project is not None:
+            for i in range(len(parts), 0, -1):
+                head = ".".join(parts[:i])
+                if head not in sym.imports:
+                    continue
+                src_name, symbol = sym.imports[head]
+                rest = parts[i:]
+                if symbol is not None:
+                    rest = [symbol] + rest
+                src = project.module(src_name)
+                if src is None and rest:
+                    src = project.module(f"{src_name}.{rest[0]}")
+                    rest = rest[1:]
+                if src is not None and len(rest) == 1 and rest[0] in src.classes:
+                    return src.classes[rest[0]]
+        candidates = self._protocol_index.get(parts[-1], [])
+        if len(candidates) == 1:
+            return candidates[0]
+        return None
+
+    def _attr_types(self, cls_node):
+        """attr name -> ClassSymbols for `self.X = Ctor()` assignments
+        anywhere in the class body."""
+        cached = self._attr_types_cache.get(id(cls_node))
+        if cached is not None:
+            return cached
+        out = {}
+        for sub in ast.walk(cls_node):
+            if not (isinstance(sub, ast.Assign)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            cls = self._resolve_ctor(sub.value)
+            if cls is None:
+                continue
+            for tgt in sub.targets:
+                key = dotted(tgt)
+                if key and key.startswith("self.") and key.count(".") == 1:
+                    out[key.split(".", 1)[1]] = cls
+        self._attr_types_cache[id(cls_node)] = out
+        return out
+
+    def _local_bindings(self, fn_node):
+        """(name -> ClassSymbols, local lock names) from one linear
+        pass over the function's own statements."""
+        types, locks = {}, set()
+        attr_types = (self._attr_types(self._cls_node)
+                      if self._cls_node is not None else {})
+        for node in _scope_walk(fn_node):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            bound_cls = None
+            is_lock = False
+            if isinstance(value, ast.Call):
+                fname = dotted(value.func)
+                if fname and fname.split(".")[-1] in LOCK_FACTORY_TAILS:
+                    is_lock = True
+                else:
+                    bound_cls = self._resolve_ctor(value)
+            else:
+                vname = dotted(value)
+                if vname is None:
+                    pass
+                elif vname.startswith("self.") and vname.count(".") == 1:
+                    bound_cls = attr_types.get(vname.split(".", 1)[1])
+                elif vname in types:
+                    bound_cls = types[vname]
+                elif vname in locks:
+                    is_lock = True
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if bound_cls is not None:
+                        types[tgt.id] = bound_cls
+                    elif is_lock:
+                        locks.add(tgt.id)
+        return types, locks
+
+    def _key_class(self, key):
+        """ClassSymbols a dotted receiver key binds to, or None."""
+        if key == "self":
+            return self._self_cls
+        if key.startswith("self.") and key.count(".") == 1:
+            if self._cls_node is None:
+                return None
+            return self._attr_types(self._cls_node).get(key.split(".", 1)[1])
+        if "." not in key:
+            return self._local_types.get(key)
+        return None
+
+    def _is_lock(self, key):
+        if key.startswith("self.") and key.count(".") == 1:
+            cls_sym = self._cls_sym
+            return (cls_sym is not None
+                    and key.split(".", 1)[1] in cls_sym.lock_attrs)
+        if "." not in key:
+            return (key in self._local_locks
+                    or key in self.ctx.symbols.module_locks)
+        return False
+
+    # -- ownership transfer -------------------------------------------------
+
+    def _escaping_names(self, fn_node):
+        """Names whose value leaves the function: returned, yielded, or
+        stored on self."""
+        out = set()
+
+        def add_expr(expr):
+            if isinstance(expr, ast.Name):
+                out.add(expr.id)
+            elif isinstance(expr, (ast.Tuple, ast.List)):
+                for elt in expr.elts:
+                    add_expr(elt)
+
+        for node in _scope_walk(fn_node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                add_expr(node.value)
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)):
+                if node.value is not None:
+                    add_expr(node.value)
+            elif isinstance(node, ast.Assign):
+                if any(
+                    (dotted(t) or "").startswith("self.")
+                    for t in node.targets
+                ):
+                    add_expr(node.value)
+        return out
+
+    def _transferred(self, call):
+        """Is this acquire's result handed to the caller / object state
+        (so the obligation is not this function's to discharge)? Two
+        shapes: the call's RESULT escapes (returned / yielded / stored
+        on self / bound to an escaping name), or the RECEIVER itself is
+        an escaping local (`r.stage(b); ...; return r` — the factory
+        idiom hands the half-open object, obligation and all, to the
+        caller)."""
+        if isinstance(call.func, ast.Attribute):
+            recv = dotted(call.func.value)
+            if recv is not None and "." not in recv and recv in self._escaping:
+                return True
+        node = call
+        while True:
+            parent = self._parents.get(id(node))
+            if parent is None:
+                return False
+            if isinstance(parent, (ast.Yield, ast.YieldFrom)):
+                return True
+            if isinstance(parent, ast.stmt):
+                break
+            node = parent
+        if isinstance(parent, ast.Return):
+            return True
+        if isinstance(parent, ast.Assign):
+            for tgt in parent.targets:
+                key = dotted(tgt)
+                if key is None:
+                    continue
+                if key.startswith("self."):
+                    return True
+                if key in self._escaping:
+                    return True
+        return False
+
+    # -- one-hop helper credit ----------------------------------------------
+
+    def _resolve_function(self, fname):
+        sym = self.ctx.symbols
+        if fname in sym.functions:
+            return sym.functions[fname]
+        project = self.ctx.project
+        if project is None:
+            return None
+        parts = fname.split(".")
+        for i in range(len(parts), 0, -1):
+            head = ".".join(parts[:i])
+            if head not in sym.imports:
+                continue
+            src_name, symbol = sym.imports[head]
+            rest = parts[i:]
+            if symbol is not None:
+                rest = [symbol] + rest
+            src = project.module(src_name)
+            if src is None and rest:
+                src = project.module(f"{src_name}.{rest[0]}")
+                rest = rest[1:]
+            if src is not None and len(rest) == 1 and rest[0] in src.functions:
+                return src.functions[rest[0]]
+        return None
+
+    def _helper_released_keys(self, call, fname):
+        """Caller keys a one-hop callee releases: `self.M()` scanning M
+        for `self.attr.release()`-shaped calls, plus param-matched
+        releases for tracked objects passed positionally."""
+        parts = fname.split(".")
+        callee = None
+        same_class = False
+        if parts[0] == "self" and len(parts) == 2 and self._cls_node is not None:
+            for item in self._cls_node.body:
+                if (isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and item.name == parts[1]):
+                    callee = item
+                    same_class = True
+                    break
+        elif len(parts) == 1:
+            callee = self._resolve_function(fname)
+        if callee is None:
+            return set()
+        keys = set()
+        if same_class:
+            attr_types = self._attr_types(self._cls_node)
+            for node in _scope_walk(callee):
+                if not isinstance(node, ast.Call):
+                    continue
+                cf = dotted(node.func)
+                if not cf or not cf.startswith("self.") or cf.count(".") != 2:
+                    continue
+                _self, attr, meth = cf.split(".")
+                tcls = attr_types.get(attr)
+                if tcls is not None and (
+                    meth in _release_methods(tcls)
+                    or meth in tcls.protocol_terminal
+                ):
+                    keys.add(f"self.{attr}")
+                if (meth == "release" and self._cls_sym is not None
+                        and attr in self._cls_sym.lock_attrs):
+                    keys.add(f"self.{attr}")
+        params = [a.arg for a in callee.args.posonlyargs + callee.args.args]
+        if params and params[0] == "self":
+            params = params[1:]
+        argmap = {}
+        for pname, argexpr in zip(params, call.args):
+            k = dotted(argexpr)
+            if k is None:
+                continue
+            tcls = self._key_class(k)
+            if tcls is not None or self._is_lock(k):
+                argmap[pname] = (k, tcls)
+        if argmap:
+            for node in _scope_walk(callee):
+                if not isinstance(node, ast.Call):
+                    continue
+                cf = dotted(node.func)
+                if not cf or "." not in cf:
+                    continue
+                root, meth = cf.rsplit(".", 1)
+                if root not in argmap:
+                    continue
+                key, tcls = argmap[root]
+                if tcls is not None:
+                    if (meth in _release_methods(tcls)
+                            or meth in tcls.protocol_terminal):
+                        keys.add(key)
+                elif meth == "release":
+                    keys.add(key)
+        return keys
+
+    # -- events ---------------------------------------------------------------
+
+    def _call_events(self, call, events):
+        fname = dotted(call.func)
+        if fname is None:
+            return
+        if "." in fname:
+            recv, meth = fname.rsplit(".", 1)
+        else:
+            recv, meth = None, fname
+        if recv is not None:
+            cls_sym = self._key_class(recv)
+            if cls_sym is not None and cls_sym.has_protocols():
+                acquires = _acquire_methods(cls_sym)
+                if meth in acquires:
+                    if not self._transferred(call):
+                        obl = _Obligation(
+                            next(self._oid_counter), recv, cls_sym, meth,
+                            acquires[meth], call, "pair",
+                        )
+                        self._obls[obl.oid] = obl
+                        events.append(("acq", obl.oid, recv))
+                    return
+                if meth in _release_methods(cls_sym):
+                    events.append(("rel", recv))
+                    return
+                if meth in cls_sym.protocol_terminal:
+                    events.append(("close", recv))
+                    return
+                if cls_sym.protocol_terminal:
+                    events.append(("use", recv, meth, call, cls_sym))
+                return
+            if meth in ("acquire", "release") and self._is_lock(recv):
+                if meth == "acquire":
+                    obl = _Obligation(
+                        next(self._oid_counter), recv, None, "acquire",
+                        "release", call, "lock",
+                    )
+                    self._obls[obl.oid] = obl
+                    events.append(("acq", obl.oid, recv))
+                else:
+                    events.append(("rel", recv))
+                return
+        for key in sorted(self._helper_released_keys(call, fname)):
+            events.append(("helper-rel", key))
+
+    def _stmt_events(self, stmt):
+        cached = self._events_cache.get(id(stmt))
+        if cached is not None:
+            return cached
+        events = []
+
+        def visit(node):
+            if isinstance(node, (ast.Lambda, ast.GeneratorExp)):
+                return  # lazy bodies don't execute at this statement
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if isinstance(node, ast.Call):
+                self._call_events(node, events)
+
+        for root in _eval_order_exprs(stmt):
+            visit(root)
+        killed = []
+        if isinstance(stmt, ast.Assign):
+            killed = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            killed = [stmt.target]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            killed = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            killed = stmt.targets
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            killed = [i.optional_vars for i in stmt.items
+                      if i.optional_vars is not None]
+        for tgt in killed:
+            for key in _target_keys(tgt):
+                events.append(("kill", key))
+        events = tuple(events)
+        self._events_cache[id(stmt)] = events
+        return events
+
+    def _node_events(self, node):
+        stmt = node.stmt
+        if (node.kind != K_STMT or stmt is None
+                or not isinstance(stmt, ast.stmt)):
+            return ()
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return ()
+        return self._stmt_events(stmt)
+
+    # -- the transfer function ------------------------------------------------
+
+    def _apply(self, events, state, normal):
+        open_, closed = set(state[0]), set(state[1])
+        for ev in events:
+            tag = ev[0]
+            if tag == "acq":
+                # A call that raised never completed: its acquire did
+                # not happen on the exception edge.
+                if normal:
+                    open_.add(ev[1])
+            elif tag in ("rel", "helper-rel"):
+                key = ev[1]
+                open_ = {o for o in open_ if self._obls[o].key != key}
+            elif tag == "close":
+                key = ev[1]
+                closed.add(key)
+                open_ = {o for o in open_ if self._obls[o].key != key}
+            elif tag == "kill":
+                key = ev[1]
+                closed.discard(key)
+                open_ = {o for o in open_ if self._obls[o].key != key}
+        return (frozenset(open_), frozenset(closed))
+
+    # -- per-function analysis ------------------------------------------------
+
+    def _exempt(self, fn_node):
+        if fn_node.name in _ALWAYS_EXEMPT:
+            return True
+        cls_sym = self._cls_sym
+        if cls_sym is not None and fn_node.name in cls_sym.protocol_methods():
+            # close()/release() bodies are where "unpaired" calls ARE
+            # the implementation.
+            return True
+        return False
+
+    def _analyze_function(self, fn_node, cls_node):
+        self._cls_node = cls_node
+        self._cls_sym = (self.ctx.symbols.classes.get(cls_node.name)
+                         if cls_node is not None else None)
+        self._self_cls = self._cls_sym
+        if self._exempt(fn_node):
+            return
+        self._local_types, self._local_locks = self._local_bindings(fn_node)
+        self._escaping = self._escaping_names(fn_node)
+        self._parents = {
+            id(child): parent
+            for parent in ast.walk(fn_node)
+            for child in ast.iter_child_nodes(parent)
+        }
+        self._obls = {}
+        self._events_cache = {}
+        cfg = build_cfg(fn_node)
+        events = [self._node_events(n) for n in cfg.nodes]
+        if not self._obls and not any(
+            ev and any(e[0] in ("use", "close") for e in ev) for ev in events
+        ):
+            return  # nothing tracked — skip the fixpoint
+        bottom = None
+        in_states = [bottom] * len(cfg.nodes)
+        in_states[cfg.entry_idx] = (frozenset(), frozenset())
+        work = [cfg.entry_idx]
+        while work:
+            idx = work.pop()
+            state = in_states[idx]
+            outs = {}
+            for succ, kind in cfg.nodes[idx].succs:
+                out = outs.get(kind)
+                if out is None:
+                    out = self._apply(events[idx], state, kind == EDGE_NORMAL)
+                    outs[kind] = out
+                prev = in_states[succ]
+                merged = out if prev is None else (
+                    prev[0] | out[0], prev[1] | out[1]
+                )
+                if merged != prev:
+                    in_states[succ] = merged
+                    work.append(succ)
+        self._report(fn_node, cfg, events, in_states)
+
+    def _report(self, fn_node, cfg, events, in_states):
+        # use-after-close: replay each node's events from its in-state.
+        for node in cfg.nodes:
+            evs = events[node.idx]
+            if not evs or in_states[node.idx] is None:
+                continue
+            if not any(e[0] == "use" for e in evs):
+                continue
+            state = in_states[node.idx]
+            closed = set(state[1])
+            for ev in evs:
+                if ev[0] == "use":
+                    _tag, key, meth, call, cls_sym = ev
+                    if key in closed:
+                        term = sorted(cls_sym.protocol_terminal)[0]
+                        self._emit(
+                            RULE_USE_AFTER_CLOSE, call,
+                            f"`{key}.{meth}()` may run after terminal "
+                            f"`{key}.{term}()` — {cls_sym.name}'s "
+                            f"lifecycle ends at `{term}()`",
+                        )
+                elif ev[0] == "close":
+                    closed.add(ev[1])
+                elif ev[0] == "kill":
+                    closed.discard(ev[1])
+        # leaks at the two exits.
+        exit_state = in_states[cfg.exit_idx]
+        raise_state = in_states[cfg.raise_idx]
+        leak_normal = set(exit_state[0]) if exit_state is not None else set()
+        leak_exc = set(raise_state[0]) if raise_state is not None else set()
+        released_keys = {
+            ev[1]
+            for evs in events
+            for ev in evs
+            if ev[0] in ("rel", "helper-rel", "close")
+        }
+        for oid in sorted(leak_normal | leak_exc):
+            obl = self._obls[oid]
+            if obl.kind == "lock":
+                if oid in leak_exc and oid not in leak_normal:
+                    self._emit(
+                        RULE_LOCK_RAISE, obl.node,
+                        f"`{obl.key}.acquire()` in `{fn_node.name}` can be "
+                        f"escaped by a raise before `{obl.key}.release()` — "
+                        f"use `with {obl.key}:` or release in a finally",
+                    )
+                continue
+            pair = f"{obl.acquire}->{obl.release}"
+            if oid in leak_normal:
+                self._emit(
+                    RULE_LEAK, obl.node,
+                    f"`{obl.key}.{obl.acquire}()` opens a {obl.cls.name} "
+                    f"{pair} obligation that reaches the exit of "
+                    f"`{fn_node.name}` with no `{obl.release}()` and no "
+                    "ownership transfer",
+                )
+            elif obl.key in released_keys:
+                self._emit(
+                    RULE_MISSING_FINALLY, obl.node,
+                    f"`{obl.key}.{obl.release}()` pairs with "
+                    f"`{obl.key}.{obl.acquire}()` only on the fall-through "
+                    f"path of `{fn_node.name}` — an exception between them "
+                    f"leaks the {obl.cls.name}; move the release into a "
+                    "finally",
+                )
+            else:
+                self._emit(
+                    RULE_LEAK, obl.node,
+                    f"`{obl.key}.{obl.acquire}()` opens a {obl.cls.name} "
+                    f"{pair} obligation with no reachable `{obl.release}()` "
+                    f"on the exceptional paths out of `{fn_node.name}`",
+                )
+
+    def _emit(self, rule_name, node, message):
+        key = (rule_name, node.lineno, node.col_offset)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings[rule_name].append(
+            self.ctx.finding(node, rule_name, message)
+        )
+
+
+def _analysis(ctx):
+    cached = getattr(ctx, "_lifecycle_findings", None)
+    if cached is None:
+        cached = _ModuleLifecycle(ctx).run().findings
+        ctx._lifecycle_findings = cached
+    return cached
+
+
+# --- the four v4 rules -------------------------------------------------------
+
+
+@rule(
+    RULE_LEAK,
+    "an acquired resource (a class's `# protocol: a->b` obligation) reaches "
+    "function exit — normal or exceptional — with no release and no "
+    "ownership transfer",
+    severity="error",
+)
+def _check_resource_leak(ctx):
+    yield from _analysis(ctx)[RULE_LEAK]
+
+
+@rule(
+    RULE_USE_AFTER_CLOSE,
+    "a method call on an object on some path after its terminal lifecycle "
+    "method (`# protocol: close`) — the object is dead at that point",
+    severity="error",
+)
+def _check_use_after_close(ctx):
+    yield from _analysis(ctx)[RULE_USE_AFTER_CLOSE]
+
+
+@rule(
+    RULE_LOCK_RAISE,
+    "a manually-paired lock.acquire() escaped by a raise before release() — "
+    "the shape `with lock:` would have scoped; composes with the PR 10 "
+    "lock rules, which only see with-held locks",
+    severity="error",
+)
+def _check_lock_held_across_raise(ctx):
+    yield from _analysis(ctx)[RULE_LOCK_RAISE]
+
+
+@rule(
+    RULE_MISSING_FINALLY,
+    "an acquire/release pair whose release is reachable only on the "
+    "fall-through path — an exception between the calls leaks; the release "
+    "belongs in a finally",
+    severity="warning",
+)
+def _check_missing_finally(ctx):
+    yield from _analysis(ctx)[RULE_MISSING_FINALLY]

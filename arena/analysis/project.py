@@ -56,6 +56,35 @@ import re
 # The annotation convention: `self.attr = ...  # guarded_by: _lock`.
 GUARDED_BY_RE = re.compile(r"guarded_by:\s*([A-Za-z_][A-Za-z0-9_]*)")
 
+# The lifecycle convention (jaxlint v4), mirroring guarded_by: a
+# `# protocol: stage->release` / `# protocol: close` comment on the
+# DEFINING class declares its resource protocol. `a->b` is a paired
+# protocol (each call to `a` creates an obligation discharged by `b`);
+# a bare method name is a terminal protocol (after calling it, other
+# method calls on the object are use-after-close). Multiple specs may
+# share one comment, comma-separated.
+PROTOCOL_RE = re.compile(r"protocol:\s*(.+)")
+
+
+def parse_protocols(comment_text):
+    """(pairs, terminal) parsed from one comment's text: pairs is a
+    list of (acquire, release) method-name tuples, terminal a set of
+    method names. Malformed specs are skipped, never a parse error."""
+    match = PROTOCOL_RE.search(comment_text)
+    if not match:
+        return [], set()
+    pairs, terminal = [], set()
+    for spec in match.group(1).split(","):
+        spec = spec.strip()
+        if "->" in spec:
+            a, _, b = spec.partition("->")
+            a, b = a.strip(), b.strip()
+            if a.isidentifier() and b.isidentifier():
+                pairs.append((a, b))
+        elif spec.isidentifier():
+            terminal.add(spec)
+    return pairs, terminal
+
 # threading constructors whose assignment makes an attribute "a lock"
 # (a Condition wraps a lock; acquiring it IS acquiring the lock).
 LOCK_FACTORY_TAILS = frozenset({"Lock", "RLock", "Condition", "Semaphore"})
@@ -106,6 +135,22 @@ class ClassSymbols:
     spawns_thread: bool = False
     thread_targets: set = dataclasses.field(default_factory=set)
     methods: dict = dataclasses.field(default_factory=dict)  # name -> node
+    # Lifecycle protocol (jaxlint v4): `# protocol:` comment on the
+    # class header. `protocol_pairs` is [(acquire, release), ...];
+    # `protocol_terminal` is the set of terminal method names.
+    protocol_pairs: list = dataclasses.field(default_factory=list)
+    protocol_terminal: set = dataclasses.field(default_factory=set)
+
+    def has_protocols(self) -> bool:
+        return bool(self.protocol_pairs or self.protocol_terminal)
+
+    def protocol_methods(self) -> set:
+        """Every method name that participates in a declared protocol."""
+        out = set(self.protocol_terminal)
+        for a, b in self.protocol_pairs:
+            out.add(a)
+            out.add(b)
+        return out
 
     def lock_ids(self):
         return {f"{self.module}.{self.name}.{a}" for a in sorted(self.lock_attrs)}
@@ -388,6 +433,13 @@ def module_symbols(path: str, tree, comments: dict) -> ModuleSymbols:
         if not isinstance(node, ast.ClassDef):
             continue
         cls = ClassSymbols(name=node.name, module=name, node=node)
+        # `# protocol:` sits on the class header (same line as the
+        # `class` keyword, or a continuation line before the body).
+        first_body_line = node.body[0].lineno if node.body else node.lineno
+        for ln in range(node.lineno, max(first_body_line, node.lineno + 1)):
+            pairs, terminal = parse_protocols(comments.get(ln, ""))
+            cls.protocol_pairs.extend(pairs)
+            cls.protocol_terminal |= terminal
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call):
                 fname = dotted(sub.func)

@@ -521,7 +521,10 @@ def run_ingest_benchmark():
     # <3% budget covers window rotation + profiler sampling, not just
     # the registry writes.
     obs_live.enable_ops(interval_s=0.5)
-    obs_live.start_ops()
+    # One-shot bench process: a gate failure raises out, the rc-2
+    # wrapper dumps the debug bundle and the process exits — the
+    # daemonized ops threads die with it, so no try/finally here.
+    obs_live.start_ops()  # jaxlint: disable=missing-finally-for-paired-call
     all_slices = _batch_slices(total, batch)
     null_build_s = float("inf")
     live_build_s = float("inf")
@@ -705,7 +708,9 @@ def run_pipeline_benchmark():
     # Windows + profiler run live through the measured streams (PR 13):
     # the <3% budget covers the whole ops plane, not just the registry.
     obs_live.enable_ops(interval_s=0.5)
-    obs_live.start_ops()
+    # One-shot bench process (see run_ingest_benchmark): on a gate
+    # failure the process exits and the daemon ops threads die with it.
+    obs_live.start_ops()  # jaxlint: disable=missing-finally-for-paired-call
     eng_sync = engine.ArenaEngine(num_players)
     eng_async = engine.ArenaEngine(num_players)
     eng_cold = engine.ArenaEngine(num_players)
@@ -1083,7 +1088,9 @@ def run_soak_benchmark():
     # full measured window stays inside the slow burn-rate window, and
     # the steady-state silence gate below reads real evaluations.
     obs_live.enable_ops(interval_s=1.0, intervals=60)
-    obs_live.start_ops()
+    # One-shot bench process (see run_ingest_benchmark): on a gate
+    # failure the process exits and the daemon ops threads die with it.
+    obs_live.start_ops()  # jaxlint: disable=missing-finally-for-paired-call
     srv = serving.ArenaServer(
         num_players=num_players,
         max_staleness_matches=stream_batch,
@@ -1321,7 +1328,11 @@ def run_frontend_benchmark():
     # first-call-wins, so these knobs (1s sub-intervals, 60-deep ring)
     # hold when `ArenaServer.__init__` and `wire.start()` re-enter it.
     obs_live.enable_ops(interval_s=1.0, intervals=60)
-    obs_live.start_ops()
+    # Ownership transfer the analyzer cannot see: `wire.close()` at the
+    # end of the run stops the ops plane (ArenaHTTPServer.close calls
+    # obs.stop_ops()); on a gate failure the one-shot process exits and
+    # the daemon ops threads die with it.
+    obs_live.start_ops()  # jaxlint: disable=resource-leaked-on-exception
     srv = serving.ArenaServer(
         num_players=num_players,
         max_staleness_matches=stream_batch,

@@ -192,7 +192,7 @@ def pack_epoch(num_players, winners, losers, batch_size, dtype=np.float32,
     )
 
 
-class ArenaEngine:
+class ArenaEngine:  # protocol: shutdown
     """Online Elo over a fixed player set, with batched Bradley–Terry.
 
     One jitted update function serves every batch: its input shapes are

@@ -402,6 +402,7 @@ class _Slot:
     """One staging slot: preallocated bucket-shaped host arrays."""
 
     def __init__(self, bucket, num_players, dtype):
+        self.bucket = bucket
         self.w = np.zeros(bucket, np.int32)
         self.l = np.zeros(bucket, np.int32)
         self.valid = np.zeros(bucket, dtype)
@@ -412,7 +413,7 @@ class _Slot:
         self.in_flight = False
 
 
-class StagingBuffers:
+class StagingBuffers:  # protocol: stage->release
     """Reusable, double-buffered host→device staging per pow2 bucket.
 
     `stage(winners, losers)` fills the NEXT slot of the batch's bucket
@@ -494,6 +495,27 @@ class StagingBuffers:
             slot.in_flight = False
             self._cond.notify_all()
 
+    def _abandon(self, slot):
+        """Un-acquire THIS slot after a failed pack. release() retires
+        the FIFO head, which mid-pack is some OTHER dispatch's slot —
+        abandoning must target the exact slot or the in-flight queue
+        loses sync with the dispatch order."""
+        with self._cond:
+            try:
+                self._inflight.remove(slot)
+            except ValueError:
+                pass  # never enqueued / already released
+            slot.in_flight = False
+            # Point the rotation back at the freed slot: _acquire
+            # already advanced past it, and without the rewind the next
+            # stage() of this bucket lands on an older still-in-flight
+            # slot and trips the rotation guard while this one sits
+            # idle.
+            ring = self._rings.get(slot.bucket, ())
+            if slot in ring:
+                self._next[slot.bucket] = ring.index(slot)
+            self._cond.notify_all()
+
     def stage(self, winners, losers, block=False):
         """Pack one validated batch through a reusable slot."""
         with self._obs.span("ingest.staging"):
@@ -506,28 +528,38 @@ class StagingBuffers:
         n = w.shape[0]
         b = bucket_size(n, self.min_bucket)
         slot = self._acquire(b, block)
-        slot.w[:n] = w
-        slot.w[n:] = 0
-        slot.l[:n] = l
-        slot.l[n:] = 0
-        slot.valid[:n] = 1
-        slot.valid[n:] = 0
-        slot.combined[:b] = slot.w
-        slot.combined[b:] = slot.l
-        slot.perm[:] = np.argsort(slot.combined, kind="stable")
-        slot.sorted_keys[:] = slot.combined[slot.perm]
-        slot.bounds[:] = np.searchsorted(
-            slot.sorted_keys, np.arange(self.num_players + 1), side="left"
-        )
-        self.stages += 1
-        return PackedBatch(
-            jnp.asarray(slot.w),
-            jnp.asarray(slot.l),
-            jnp.asarray(slot.valid),
-            jnp.asarray(slot.perm),
-            jnp.asarray(slot.bounds),
-            n,
-        )
+        # A failure past _acquire would otherwise leak the slot
+        # permanently: it sits in _inflight with in_flight=True, no
+        # PackedBatch ever reaches the dispatcher, so no release() ever
+        # retires it — after `depth` such failures the bucket stalls
+        # every stage() forever (the silent class v4's
+        # resource-leaked-on-exception rule exists for).
+        try:
+            slot.w[:n] = w
+            slot.w[n:] = 0
+            slot.l[:n] = l
+            slot.l[n:] = 0
+            slot.valid[:n] = 1
+            slot.valid[n:] = 0
+            slot.combined[:b] = slot.w
+            slot.combined[b:] = slot.l
+            slot.perm[:] = np.argsort(slot.combined, kind="stable")
+            slot.sorted_keys[:] = slot.combined[slot.perm]
+            slot.bounds[:] = np.searchsorted(
+                slot.sorted_keys, np.arange(self.num_players + 1), side="left"
+            )
+            self.stages += 1
+            return PackedBatch(
+                jnp.asarray(slot.w),
+                jnp.asarray(slot.l),
+                jnp.asarray(slot.valid),
+                jnp.asarray(slot.perm),
+                jnp.asarray(slot.bounds),
+                n,
+            )
+        except BaseException:
+            self._abandon(slot)
+            raise
 
 
 def chunk_layout(perm, bounds, chunk_entries=DEFAULT_CHUNK_ENTRIES):

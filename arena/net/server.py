@@ -199,7 +199,7 @@ class _Handler(BaseHTTPRequestHandler):
         }
 
 
-class ArenaHTTPServer:
+class ArenaHTTPServer:  # protocol: start->close
     """The wire tier: one `ThreadingHTTPServer` over one `ArenaServer`
     (+ optionally one `FrontDoor` for the submit path; without one the
     server is a read-only replica and /submit answers 503).
@@ -232,13 +232,21 @@ class ArenaHTTPServer:
         # The ops plane serves live at /debug/*: rotation + sampling
         # threads ride the wire server's lifecycle (no-op on NULL obs).
         self.obs.start_ops()
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever,
-            kwargs={"poll_interval": 0.05},
-            name="arena-wire-server",
-            daemon=True,
-        )
-        self._thread.start()
+        try:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                name="arena-wire-server",
+                daemon=True,
+            )
+            self._thread.start()
+        except BaseException:
+            # A failed spawn must not strand the rotation/sampling
+            # threads start_ops just launched: nobody holds a handle to
+            # call close() on a server that never started.
+            self._thread = None
+            self.obs.stop_ops()
+            raise
         return self
 
     def close(self):

@@ -87,7 +87,7 @@ from arena.obs.windows import NullWindow, SlidingWindow, WindowError
 DEFAULT_EVENT_CAPACITY = 1024
 
 
-class Observability:
+class Observability:  # protocol: start_ops->stop_ops
     """One registry + one tracer + one bounded recent-event log, behind
     the instrumentation surface."""
 

@@ -66,7 +66,7 @@ class ProfilerError(RuntimeError):
     """Profiler misuse or a dead sampler thread."""
 
 
-class SamplingProfiler:
+class SamplingProfiler:  # protocol: start->close
     """Samples every live thread's stack at `hz`, folding into
     per-role collapsed stacks."""
 

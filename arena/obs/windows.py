@@ -222,7 +222,7 @@ class WindowDelta:
         }
 
 
-class SlidingWindow:
+class SlidingWindow:  # protocol: start->close
     """Ring of cumulative boundary snapshots over one `Registry`.
 
     The ring holds `intervals` slots; `_head` is the slot the NEXT

@@ -103,7 +103,7 @@ class PipelineError(RuntimeError):
     """The pipeline cannot make progress (packer dead or errored)."""
 
 
-class IngestPipeline:
+class IngestPipeline:  # protocol: close
     """Background packing thread + bounded ingest queue for one engine.
 
     Built lazily by `ArenaEngine.ingest_async()` (or explicitly via

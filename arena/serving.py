@@ -342,7 +342,7 @@ class ServingView:
         self.seq = seq
 
 
-class ArenaServer:
+class ArenaServer:  # protocol: close
     """The serving surface over one `ArenaEngine`.
 
     Construction wires the production-mode sanitizers (count-mode
@@ -741,7 +741,11 @@ class ArenaServer:
             # line up rather than persisting a torn snapshot.
             deadline = time.monotonic() + 10.0
             while True:
-                ratings, watermark = eng.ratings_snapshot()
+                # Deliberate post-shutdown read: shutdown(spill=True) is
+                # the restart-mid-stream form — the engine stays readable
+                # and restarts its pipeline lazily on the next
+                # ingest_async, so this is the contract, not a zombie.
+                ratings, watermark = eng.ratings_snapshot()  # jaxlint: disable=use-after-close
                 state = eng._store.export_state()
                 if watermark == state["num_matches"]:
                     break

@@ -641,6 +641,49 @@ MUTATIONS = (
         "test_debug_endpoints_serve_the_standard_envelope (the /debug/"
         "window body must be a JSON dict wearing the pair)",
     ),
+    (
+        "exception-edge-dropped-from-cfg",
+        "arena/analysis/cfg.py",
+        "            self.cfg._edge(idx, frame.exc, EDGE_EXC)",
+        "            pass  # exception edges deliberately dropped",
+        "_simple() is the single point every raise-capable statement "
+        "passes through; with its exception edge dropped, the whole v4 "
+        "analyzer sees only the happy path — the happy-path-only release "
+        "shape lints clean and missing-finally-for-paired-call goes mute "
+        "— killed by test_missing_finally_requires_the_exception_edge "
+        "(and the CFG totality sweep "
+        "test_every_raise_capable_statement_has_an_exception_successor)",
+    ),
+    (
+        "lifecycle-terminal-state-not-tracked",
+        "arena/analysis/lifecycle.py",
+        '            elif tag == "close":\n'
+        "                key = ev[1]\n"
+        "                closed.add(key)",
+        '            elif tag == "close":\n'
+        "                key = ev[1]",
+        "the typestate transfer must RECORD the terminal transition, not "
+        "just discharge open obligations: with the closed-set update "
+        "dropped, a method call after close()/shutdown() on a later "
+        "statement reads as a live object and use-after-close never "
+        "fires — killed by "
+        "test_use_after_close_fires_and_terminal_state_is_tracked (and "
+        "the bad_use_after_close corpus contract)",
+    ),
+    (
+        "release-in-helper-not-credited",
+        "arena/analysis/lifecycle.py",
+        "        for key in sorted(self._helper_released_keys(call, fname)):\n"
+        '            events.append(("helper-rel", key))',
+        "        for key in sorted(self._helper_released_keys(call, fname)):\n"
+        "            pass  # helper releases deliberately not credited",
+        "the ONE interprocedural hop is what lets the real teardown-"
+        "helper idiom (engine._dispatch_packed, a shutdown(res) module "
+        "function) lint clean; with helper releases not credited every "
+        "correctly-paired helper call flags and the clean-tree gate goes "
+        "red — killed by test_release_inside_helper_counts (and "
+        "test_full_tree_lints_clean_with_concurrency_rules_active)",
+    ),
 )
 
 

@@ -1,0 +1,291 @@
+"""Properties of the jaxlint v4 exception-edge CFG (arena/analysis/cfg.py).
+
+Two classes of pin:
+
+- TOTALITY over the real tree: every raise-capable statement node in
+  every function of arena/, tests/, and bench.py carries an exception
+  successor, and every graph is well-formed (no dangling indices, no
+  stuck non-terminal nodes). This is the property the
+  exception-edge-dropped-from-cfg mutant breaks.
+- SHAPE on synthetic functions: finally duplication dominating both
+  edge kinds, with-unwind on the body's exceptional path, break/
+  continue/return routed through enclosing finally copies, handler
+  dispatch fanning with an unmatched path unless a catch-all exists.
+
+Imports `arena.analysis.cfg` directly (stdlib-only, never touches jax).
+"""
+
+import ast
+import pathlib
+
+from arena.analysis.cfg import (
+    EDGE_EXC,
+    EDGE_NORMAL,
+    K_STMT,
+    K_WITH_UNWIND,
+    build_cfg,
+    stmt_can_raise,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _functions_of(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _iter_repo_functions():
+    paths = [REPO / "bench.py"]
+    for sub in ("arena", "tests"):
+        paths.extend(sorted((REPO / sub).rglob("*.py")))
+    for path in paths:
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"))
+        except SyntaxError:  # badcorpus keeps only parseable files today,
+            continue  # but the CFG suite must not depend on that
+        for fn in _functions_of(tree):
+            yield path, fn
+
+
+def _fn(src, name=None):
+    for node in _functions_of(ast.parse(src)):
+        if name is None or node.name == name:
+            return node
+    raise AssertionError(f"no function {name!r} in source")
+
+
+def _reachable_avoiding(cfg, start, blocked):
+    """Node set reachable from `start` along paths that never enter a
+    node in `blocked` — the dominance probe: if the exits drop out of
+    this set, every path runs one of the blocked nodes."""
+    seen, stack = set(), [start]
+    while stack:
+        idx = stack.pop()
+        if idx in seen or idx in blocked:
+            continue
+        seen.add(idx)
+        stack.extend(succ for succ, _kind in cfg.nodes[idx].succs)
+    return seen
+
+
+# --- totality over the real tree ------------------------------------------
+
+
+def test_every_raise_capable_statement_has_an_exception_successor():
+    """THE property: no raise-capable statement is silently treated as
+    safe anywhere in the repo. Counted, so the sweep cannot go vacuous
+    if the walk breaks."""
+    checked = 0
+    for path, fn in _iter_repo_functions():
+        cfg = build_cfg(fn)
+        for node in cfg.nodes:
+            if node.kind == K_STMT and node.raise_capable:
+                kinds = {kind for _succ, kind in node.succs}
+                assert EDGE_EXC in kinds, (
+                    f"{path}:{getattr(node.stmt, 'lineno', '?')}: "
+                    f"raise-capable statement with no exception successor"
+                )
+                checked += 1
+    assert checked > 1000, f"sweep went vacuous ({checked} nodes checked)"
+
+
+def test_cfgs_are_well_formed_over_the_real_tree():
+    """Every edge lands on a real node with a known kind; every
+    non-terminal node can go somewhere (no stuck states for the
+    typestate worklist to lose obligations in)."""
+    for path, fn in _iter_repo_functions():
+        cfg = build_cfg(fn)
+        terminal = {cfg.exit_idx, cfg.raise_idx}
+        assert cfg.nodes[cfg.entry_idx].succs
+        for node in cfg.nodes:
+            for succ, kind in node.succs:
+                assert 0 <= succ < len(cfg.nodes)
+                assert kind in (EDGE_NORMAL, EDGE_EXC)
+            if node.idx not in terminal:
+                assert node.succs, (
+                    f"{path}:{fn.name}: stuck node {node!r}"
+                )
+
+
+def test_raise_capability_is_syntactic_and_conservative():
+    assert stmt_can_raise(ast.parse("x = f()").body[0])
+    assert stmt_can_raise(ast.parse("x = d[k]").body[0])
+    assert stmt_can_raise(ast.parse("x = a + b").body[0])
+    assert stmt_can_raise(ast.parse("raise ValueError").body[0])
+    assert stmt_can_raise(ast.parse("assert x").body[0])
+    assert stmt_can_raise(ast.parse("for i in xs:\n    pass").body[0])
+    assert stmt_can_raise(ast.parse("with cm:\n    pass").body[0])
+    # Plain reads/binds are deemed safe — the heuristic the clean tree
+    # relies on (headers only: the compound bodies are separate nodes).
+    assert not stmt_can_raise(ast.parse("x = y").body[0])
+    assert not stmt_can_raise(ast.parse("pass").body[0])
+    assert not stmt_can_raise(ast.parse("if x:\n    y = f()").body[0])
+
+
+# --- finally: duplication dominating both edge kinds ----------------------
+
+
+def test_finally_dominates_both_normal_and_exceptional_exits():
+    src = (
+        "def f(res, wire):\n"
+        "    try:\n"
+        "        wire.send()\n"
+        "    finally:\n"
+        "        res.release()\n"
+    )
+    fn = _fn(src)
+    cfg = build_cfg(fn)
+    send = cfg.stmt_nodes(fn.body[0].body[0])[0]
+    release_idxs = {n.idx for n in cfg.stmt_nodes(fn.body[0].finalbody[0])}
+    assert len(release_idxs) >= 2  # one copy per continuation
+    # The exceptional and the normal successor each reach a release copy...
+    exc = {s for s, k in send.succs if k == EDGE_EXC}
+    norm = {s for s, k in send.succs if k == EDGE_NORMAL}
+    assert exc and norm
+    assert all(release_idxs & cfg.reachable_from(s) for s in exc | norm)
+    # ...and NO path from entry reaches either exit without running one:
+    # the finally dominates both edge kinds.
+    reach = _reachable_avoiding(cfg, cfg.entry_idx, release_idxs)
+    assert cfg.exit_idx not in reach
+    assert cfg.raise_idx not in reach
+
+
+def test_return_routes_through_the_finally_copy():
+    src = (
+        "def f(res):\n"
+        "    try:\n"
+        "        return res.value()\n"
+        "    finally:\n"
+        "        res.release()\n"
+    )
+    fn = _fn(src)
+    cfg = build_cfg(fn)
+    ret = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Return))
+    release_idxs = {n.idx for n in cfg.stmt_nodes(fn.body[0].finalbody[0])}
+    norm = {s for s, k in ret.succs if k == EDGE_NORMAL}
+    assert norm and norm <= release_idxs  # return enters the finally first
+    assert cfg.exit_idx in cfg.reachable_from(next(iter(norm)))
+
+
+def test_break_and_continue_route_through_enclosing_finally():
+    src = (
+        "def f(items, res):\n"
+        "    for it in items:\n"
+        "        try:\n"
+        "            if it:\n"
+        "                break\n"
+        "            continue\n"
+        "        finally:\n"
+        "            res.note()\n"
+        "    return res\n"
+    )
+    fn = _fn(src)
+    cfg = build_cfg(fn)
+    for_stmt = fn.body[0]
+    note_idxs = {
+        n.idx for n in cfg.stmt_nodes(for_stmt.body[0].finalbody[0])
+    }
+    brk = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Break))
+    cont = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Continue))
+    brk_norm = {s for s, k in brk.succs if k == EDGE_NORMAL}
+    cont_norm = {s for s, k in cont.succs if k == EDGE_NORMAL}
+    assert brk_norm and brk_norm <= note_idxs
+    assert cont_norm and cont_norm <= note_idxs
+    # Distinct continuations get distinct finally copies, and continue's
+    # copy flows back to the loop header while break's does not.
+    assert brk_norm != cont_norm
+    header_idx = cfg.stmt_nodes(for_stmt)[0].idx
+    assert header_idx in cfg.reachable_from(next(iter(cont_norm)))
+
+
+# --- with: unwind node on the exceptional path ----------------------------
+
+
+def test_with_unwind_sits_on_the_body_exception_path():
+    src = (
+        "def f(lock, wire):\n"
+        "    with lock:\n"
+        "        wire.send()\n"
+    )
+    fn = _fn(src)
+    cfg = build_cfg(fn)
+    unwinds = [n for n in cfg.nodes if n.kind == K_WITH_UNWIND]
+    assert len(unwinds) == 1  # __exit__-on-unwind is modeled exactly once
+    send = cfg.stmt_nodes(fn.body[0].body[0])[0]
+    assert (unwinds[0].idx, EDGE_EXC) in send.succs
+    assert (cfg.raise_idx, EDGE_EXC) in unwinds[0].succs
+
+
+# --- try/except dispatch --------------------------------------------------
+
+
+def test_uncaught_raise_reaches_only_the_raise_exit():
+    src = "def f():\n    raise ValueError('boom')\n"
+    cfg = build_cfg(_fn(src))
+    r = next(n for n in cfg.nodes if isinstance(n.stmt, ast.Raise))
+    assert r.succs == [(cfg.raise_idx, EDGE_EXC)]
+
+
+def test_handler_dispatch_fans_out_with_unmatched_path():
+    src = (
+        "def f(wire):\n"
+        "    try:\n"
+        "        wire.send()\n"
+        "    except KeyError:\n"
+        "        return 1\n"
+        "    except ValueError:\n"
+        "        return 2\n"
+        "    return 0\n"
+    )
+    fn = _fn(src)
+    cfg = build_cfg(fn)
+    send = cfg.stmt_nodes(fn.body[0].body[0])[0]
+    (dispatch_idx,) = {s for s, k in send.succs if k == EDGE_EXC}
+    # Two handlers plus the unmatched propagation path: neither handler
+    # is a catch-all, so a TypeError must still escape the function.
+    assert len(cfg.nodes[dispatch_idx].succs) == 3
+    assert cfg.raise_idx in cfg.reachable_from(dispatch_idx)
+    # Swapping one handler for a catch-all removes the unmatched path.
+    caught = src.replace("except ValueError:", "except Exception:")
+    fn2 = _fn(caught)
+    cfg2 = build_cfg(fn2)
+    send2 = cfg2.stmt_nodes(fn2.body[0].body[0])[0]
+    (d2,) = {s for s, k in send2.succs if k == EDGE_EXC}
+    assert len(cfg2.nodes[d2].succs) == 2
+    assert cfg2.raise_idx not in cfg2.reachable_from(d2)
+
+
+def test_nested_try_except_else_finally_edge_routing():
+    src = (
+        "def f(res, wire):\n"
+        "    try:\n"
+        "        res.stage()\n"
+        "    except KeyError:\n"
+        "        wire.nack()\n"
+        "    else:\n"
+        "        wire.send()\n"
+        "    finally:\n"
+        "        res.release()\n"
+    )
+    fn = _fn(src)
+    cfg = build_cfg(fn)
+    try_stmt = fn.body[0]
+    stage = cfg.stmt_nodes(try_stmt.body[0])[0]
+    nack = cfg.stmt_nodes(try_stmt.handlers[0].body[0])[0]
+    send = cfg.stmt_nodes(try_stmt.orelse[0])[0]
+    release_idxs = {n.idx for n in cfg.stmt_nodes(try_stmt.finalbody[0])}
+    # The body's exception goes to handler dispatch (the handler is
+    # reachable from it)...
+    (stage_exc,) = {s for s, k in stage.succs if k == EDGE_EXC}
+    assert nack.idx in cfg.reachable_from(stage_exc)
+    # ...while else-clause and handler-body exceptions propagate OUTWARD
+    # — their exception successors are finally copies, not the dispatch.
+    for node in (send, nack):
+        exc = {s for s, k in node.succs if k == EDGE_EXC}
+        assert exc and exc <= release_idxs
+    # And the finally still dominates every exit of the whole statement.
+    reach = _reachable_avoiding(cfg, cfg.entry_idx, release_idxs)
+    assert cfg.exit_idx not in reach
+    assert cfg.raise_idx not in reach

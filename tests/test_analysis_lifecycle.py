@@ -1,0 +1,246 @@
+"""Acceptance for the jaxlint v4 lifecycle/resource typestate analyzer
+(arena/analysis/lifecycle.py): each rule fires on its minimal shape,
+the sanctioned shapes stay clean, ownership transfer and the one-hop
+helper credit are honored, suppression works, and the real resource-
+owning modules lint clean under the lifecycle rules alone.
+
+These are also the named mutant killers:
+
+- lifecycle-terminal-state-not-tracked dies in
+  `test_use_after_close_fires_and_terminal_state_is_tracked` (no
+  terminal tracking -> use-after-close never fires).
+- release-in-helper-not-credited dies in
+  `test_release_inside_helper_counts` (no helper credit -> the clean
+  teardown-helper shape flags).
+- exception-edge-dropped-from-cfg dies in
+  `test_missing_finally_requires_the_exception_edge` (no exception
+  edges -> the happy-path-only release looks total and the rule goes
+  quiet).
+"""
+
+import pathlib
+
+from arena.analysis import jaxlint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+LIFECYCLE_RULES = {
+    "resource-leaked-on-exception",
+    "use-after-close",
+    "lock-held-across-raise",
+    "missing-finally-for-paired-call",
+}
+
+# A minimal protocol-annotated resource, shared by most sources below.
+RES = (
+    "class Res:  # protocol: stage->release\n"
+    "    def stage(self, b):\n"
+    "        return b\n"
+    "    def release(self):\n"
+    "        pass\n"
+    "\n"
+)
+
+
+def _rules(src):
+    return {f.rule for f in jaxlint.lint_source(src, "t.py")}
+
+
+def test_lifecycle_rules_are_registered_with_severities():
+    assert LIFECYCLE_RULES <= set(jaxlint.RULES)
+    for name in LIFECYCLE_RULES:
+        assert jaxlint.RULES[name].severity in jaxlint.SEVERITIES
+
+
+# --- resource-leaked-on-exception -----------------------------------------
+
+
+def test_leak_fires_when_no_release_exists_on_any_path():
+    src = RES + (
+        "def pack(b, wire):\n"
+        "    r = Res()\n"
+        "    r.stage(b)\n"
+        "    wire.send(b)\n"
+    )
+    assert _rules(src) == {"resource-leaked-on-exception"}
+
+
+def test_paired_release_in_finally_is_clean():
+    src = RES + (
+        "def pack(b, wire):\n"
+        "    r = Res()\n"
+        "    r.stage(b)\n"
+        "    try:\n"
+        "        wire.send(b)\n"
+        "    finally:\n"
+        "        r.release()\n"
+    )
+    assert _rules(src) == set()
+
+
+def test_returning_the_acquired_object_is_ownership_transfer():
+    src = RES + (
+        "def make(b):\n"
+        "    r = Res()\n"
+        "    r.stage(b)\n"
+        "    return r\n"
+    )
+    assert _rules(src) == set()
+
+
+# --- missing-finally-for-paired-call --------------------------------------
+
+
+def test_missing_finally_requires_the_exception_edge():
+    """The release EXISTS but only on fall-through: the finding is
+    purely a property of the exceptional paths, so it exists exactly
+    because the CFG carries exception edges — drop them and this rule
+    goes quiet (the cfg mutant's kill site)."""
+    src = RES + (
+        "def serve(b, wire):\n"
+        "    r = Res()\n"
+        "    r.stage(b)\n"
+        "    wire.send(b)\n"
+        "    r.release()\n"
+    )
+    assert _rules(src) == {"missing-finally-for-paired-call"}
+
+
+def test_release_inside_helper_counts():
+    """One interprocedural hop: the release lives in a sibling method
+    (and, below, in a bare module function) — the analyzer credits it
+    instead of flagging the teardown-helper idiom the real engine
+    uses."""
+    via_method = RES + (
+        "class Owner:\n"
+        "    def __init__(self):\n"
+        "        self._res = Res()\n"
+        "    def _teardown(self):\n"
+        "        self._res.release()\n"
+        "    def run(self, b, wire):\n"
+        "        self._res.stage(b)\n"
+        "        try:\n"
+        "            wire.send(b)\n"
+        "        finally:\n"
+        "            self._teardown()\n"
+    )
+    assert _rules(via_method) == set()
+    via_function = RES + (
+        "def shutdown(res):\n"
+        "    res.release()\n"
+        "\n"
+        "def run(b, wire):\n"
+        "    r = Res()\n"
+        "    r.stage(b)\n"
+        "    try:\n"
+        "        wire.send(b)\n"
+        "    finally:\n"
+        "        shutdown(r)\n"
+    )
+    assert _rules(via_function) == set()
+
+
+# --- use-after-close ------------------------------------------------------
+
+
+def test_use_after_close_fires_and_terminal_state_is_tracked():
+    """A method call after the protocol's terminal method flags; the
+    same call BEFORE it does not. If the analyzer stopped recording the
+    terminal transition (the terminal-state mutant), the first half
+    would go quiet."""
+    conn = (
+        "class Conn:  # protocol: close\n"
+        "    def send(self, b):\n"
+        "        pass\n"
+        "    def close(self):\n"
+        "        pass\n"
+        "\n"
+    )
+    after = conn + (
+        "def f(b):\n"
+        "    c = Conn()\n"
+        "    c.close()\n"
+        "    c.send(b)\n"
+    )
+    assert _rules(after) == {"use-after-close"}
+    before = conn + (
+        "def f(b):\n"
+        "    c = Conn()\n"
+        "    c.send(b)\n"
+        "    c.close()\n"
+    )
+    assert _rules(before) == set()
+
+
+# --- lock-held-across-raise -----------------------------------------------
+
+
+def test_lock_held_across_raise_fires_on_manual_pairing():
+    src = (
+        "import threading\n"
+        "_lk = threading.Lock()\n"
+        "def g(d, k):\n"
+        "    _lk.acquire()\n"
+        "    v = d[k]\n"
+        "    _lk.release()\n"
+        "    return v\n"
+    )
+    assert _rules(src) == {"lock-held-across-raise"}
+
+
+def test_lock_release_in_finally_or_with_is_clean():
+    manual = (
+        "import threading\n"
+        "_lk = threading.Lock()\n"
+        "def g(d, k):\n"
+        "    _lk.acquire()\n"
+        "    try:\n"
+        "        return d[k]\n"
+        "    finally:\n"
+        "        _lk.release()\n"
+    )
+    assert _rules(manual) == set()
+    scoped = (
+        "import threading\n"
+        "_lk = threading.Lock()\n"
+        "def g(d, k):\n"
+        "    with _lk:\n"
+        "        return d[k]\n"
+    )
+    assert _rules(scoped) == set()
+
+
+# --- suppression + real tree ----------------------------------------------
+
+
+def test_lifecycle_findings_honor_inline_suppression():
+    src = RES + (
+        "def pack(b, wire):\n"
+        "    r = Res()\n"
+        "    r.stage(b)  # jaxlint: disable=resource-leaked-on-exception\n"
+        "    wire.send(b)\n"
+    )
+    assert _rules(src) == set()
+
+
+def test_protocol_methods_themselves_are_exempt():
+    """Res.stage's own body necessarily manipulates half-open state —
+    the defining class's protocol methods must not self-flag (the
+    StagingBuffers.release shape)."""
+    assert _rules(RES) == set()
+
+
+def test_real_resource_owning_modules_lint_clean_under_lifecycle_rules():
+    """The modules that actually own stage->release / start->close
+    obligations, under the lifecycle rules ALONE (no other family can
+    mask a finding by erroring first)."""
+    targets = [
+        str(REPO / "arena" / "ingest.py"),
+        str(REPO / "arena" / "engine.py"),
+        str(REPO / "arena" / "pipeline.py"),
+        str(REPO / "arena" / "serving.py"),
+        str(REPO / "arena" / "net" / "server.py"),
+        str(REPO / "arena" / "obs" / "__init__.py"),
+    ]
+    findings = jaxlint.lint_paths(targets, rules=sorted(LIFECYCLE_RULES))
+    assert findings == [], "\n".join(f.format() for f in findings)

@@ -47,6 +47,11 @@ CORPUS_EXPECTED = {
     "bad_unbucketed_jit_shape.py": {"unbucketed-shape-at-jit-boundary"},
     "bad_dtype_drift.py": {"dtype-drift-into-kernel"},
     "bad_wire_taint.py": {"unvalidated-wire-input"},
+    # jaxlint v4: the lifecycle/resource typestate analyzer.
+    "bad_resource_leak_exception.py": {"resource-leaked-on-exception"},
+    "bad_use_after_close.py": {"use-after-close"},
+    "bad_lock_held_raise.py": {"lock-held-across-raise"},
+    "bad_missing_finally.py": {"missing-finally-for-paired-call"},
 }
 
 # The --format=json per-finding schema (the mechanical consumption
@@ -432,6 +437,21 @@ def test_cli_subprocess_contract():
     assert all(set(obj) == JSON_KEYS for obj in json_lines)
     assert all(obj["severity"] in jaxlint.SEVERITIES for obj in json_lines)
     assert {obj["rule"] for obj in json_lines} == set(jaxlint.RULES)
+    # --format=sarif over the same corpus: rc unchanged, stdout is ONE
+    # SARIF 2.1.0 document (the v4 satellite's CI-annotation contract,
+    # through the real entrypoint).
+    as_sarif = subprocess.run(
+        [
+            sys.executable, "-m", "arena.analysis", "--format=sarif",
+            "arena/analysis/badcorpus",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+    assert as_sarif.returncode == 1
+    doc = json.loads(as_sarif.stdout)
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    assert {r["ruleId"] for r in results} == set(jaxlint.RULES)
 
 
 # --- v3 CLI satellites: rule selection + multi-bad-path reporting ---------
@@ -531,3 +551,111 @@ def test_unreadable_file_reports_rc2_with_path_named(
     err = capsys.readouterr().err
     assert "blocked.py" in err
     assert "missing-too" in err
+
+
+# --- v4 CLI satellites: SARIF output + baseline files ---------------------
+
+
+def test_sarif_format_document_shape(capsys):
+    """--format=sarif emits ONE SARIF 2.1.0 document: rule descriptors
+    for every rule referenced, and per result the rule id, severity
+    level, message text, and a 1-based physical location — the minimal
+    shape CI annotation tooling consumes. rc semantics unchanged."""
+    rc = jaxlint.main(["--format=sarif", str(CORPUS / "bad_use_after_donate.py")])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    assert len(doc["runs"]) == 1
+    driver = doc["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "jaxlint"
+    assert {r["id"] for r in driver["rules"]} == {"use-after-donate"}
+    assert all(r["shortDescription"]["text"] for r in driver["rules"])
+    results = doc["runs"][0]["results"]
+    assert results
+    for res in results:
+        assert res["ruleId"] == "use-after-donate"
+        assert res["level"] in jaxlint.SEVERITIES
+        assert res["message"]["text"]
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+        uri = res["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+        assert uri.endswith("bad_use_after_donate.py")
+        assert "suppressions" not in res  # nothing suppressed here
+
+
+def test_sarif_marks_suppressed_findings_rc_unchanged(tmp_path, capsys):
+    """Suppressed findings appear in the SARIF document carrying an
+    inSource suppression object (the SARIF spelling of the JSON
+    format's suppressed flag) and do NOT flip the exit code."""
+    bad = (CORPUS / "bad_timing.py").read_text().replace(
+        "elapsed = time.perf_counter() - t0",
+        "elapsed = time.perf_counter() - t0  # jaxlint: disable=timing-without-block",
+    )
+    target = tmp_path / "muted.py"
+    target.write_text(bad)
+    rc = jaxlint.main(["--format=sarif", str(target)])
+    assert rc == 0
+    results = json.loads(capsys.readouterr().out)["runs"][0]["results"]
+    assert results
+    assert all(
+        res["suppressions"] == [{"kind": "inSource"}] for res in results
+    )
+
+
+def test_baseline_write_then_filter(tmp_path, capsys):
+    """First run against a missing baseline file WRITES it (rc 0 — the
+    dirty tree is recorded, not failed); the second run reports only
+    findings absent from it."""
+    baseline = tmp_path / "baseline.json"
+    target = str(CORPUS / "bad_use_after_donate.py")
+    rc = jaxlint.main([f"--baseline={baseline}", target])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert captured.out.strip() == ""  # nothing reported on the write run
+    assert "baseline written" in captured.err
+    keys = json.loads(baseline.read_text())["findings"]
+    assert keys and all(k.startswith("use-after-donate::") for k in keys)
+    # Re-run: every finding is baselined, rc drops to 0, stdout empty.
+    rc = jaxlint.main([f"--baseline={baseline}", target])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+    # A target with findings NOT in the baseline still fails.
+    rc = jaxlint.main(
+        [f"--baseline={baseline}", str(CORPUS / "bad_timing.py")]
+    )
+    assert rc == 1
+    assert "timing-without-block" in capsys.readouterr().out
+
+
+def test_baseline_is_line_drift_tolerant(tmp_path, capsys):
+    """Baseline keys are rule+path+message — moving a known finding to
+    a different line (unrelated edits above it) must not resurrect
+    it."""
+    src = (CORPUS / "bad_use_after_donate.py").read_text()
+    target = tmp_path / "mod.py"
+    target.write_text(src)
+    baseline = tmp_path / "baseline.json"
+    assert jaxlint.main([f"--baseline={baseline}", str(target)]) == 0
+    capsys.readouterr()
+    # Drift every finding down three lines without changing its message.
+    target.write_text("# pad\n# pad\n# pad\n" + src)
+    rc = jaxlint.main([f"--baseline={baseline}", str(target)])
+    assert rc == 0, capsys.readouterr().out
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_baseline_malformed_file_is_rc2(tmp_path, capsys):
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text("{not json")
+    rc = jaxlint.main(
+        [f"--baseline={baseline}", str(CORPUS / "bad_timing.py")]
+    )
+    assert rc == 2
+    assert "baseline" in capsys.readouterr().err
+    # Valid JSON of the wrong shape is equally a usage error.
+    baseline.write_text(json.dumps([1, 2, 3]))
+    rc = jaxlint.main(
+        [f"--baseline={baseline}", str(CORPUS / "bad_timing.py")]
+    )
+    assert rc == 2

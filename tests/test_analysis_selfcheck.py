@@ -36,15 +36,25 @@ ABSINT_RULES = {
     "unvalidated-wire-input",
 }
 
+# jaxlint v4: the lifecycle/resource typestate analyzer.
+LIFECYCLE_RULES = {
+    "resource-leaked-on-exception",
+    "use-after-close",
+    "lock-held-across-raise",
+    "missing-finally-for-paired-call",
+}
+
 
 def test_full_tree_lints_clean_with_concurrency_rules_active():
     """The acceptance criterion: `python -m arena.analysis` over the
-    clean tree reports 0 findings WITH the four concurrency rules AND
-    the three v3 abstract-interpretation families registered, the real
-    guarded_by annotations in place, and the real bucketing/validator
-    call sites recognized."""
+    clean tree reports 0 findings WITH the four concurrency rules, the
+    three v3 abstract-interpretation families, AND the four v4
+    lifecycle rules registered — the real guarded_by annotations, the
+    real bucketing/validator call sites, and the real `# protocol:`
+    contracts all in place."""
     assert CONCURRENCY_RULES <= set(jaxlint.RULES)
     assert ABSINT_RULES <= set(jaxlint.RULES)
+    assert LIFECYCLE_RULES <= set(jaxlint.RULES)
     findings = jaxlint.lint_paths(jaxlint.default_targets())
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
@@ -77,6 +87,24 @@ def test_clean_pass_is_not_vacuous():
         cls = ctx.symbols.classes[cls_name]
         assert cls.guarded, f"{rel}: {cls_name} lost its guarded_by contract"
         assert cls.lock_attrs, f"{rel}: {cls_name} lost its lock attrs"
+    # ...and (v4) the lifecycle pass demonstrably sees the real
+    # `# protocol:` contracts: paired, terminal-only, and ops-plane.
+    protocols = {
+        "arena/ingest.py": ("StagingBuffers", [("stage", "release")], set()),
+        "arena/engine.py": ("ArenaEngine", [], {"shutdown"}),
+        "arena/obs/__init__.py": (
+            "Observability", [("start_ops", "stop_ops")], set(),
+        ),
+    }
+    for rel, (cls_name, pairs, terminal) in protocols.items():
+        path = REPO / rel
+        ctx = jaxlint.ModuleContext(str(path), path.read_text())
+        cls = ctx.symbols.classes[cls_name]
+        assert cls.has_protocols(), f"{rel}: {cls_name} lost its protocol"
+        assert cls.protocol_pairs == pairs, f"{rel}: {cls_name} pairs drifted"
+        assert cls.protocol_terminal >= terminal, (
+            f"{rel}: {cls_name} terminal methods drifted"
+        )
 
 
 def test_every_registered_rule_fires_on_the_corpus():

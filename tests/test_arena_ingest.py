@@ -279,10 +279,12 @@ def test_staging_double_buffers_and_stops_allocating():
     in-flight, release() retires the oldest."""
     staging = ingest.StagingBuffers(P, min_bucket=256)
     w, l = make_matches(100, seed=1)
-    staging.stage(w, l)
+    # Deliberate bare stage()s with slots held in flight across the
+    # asserts: the slot mechanics ARE the subject under test here.
+    staging.stage(w, l)  # jaxlint: disable=missing-finally-for-paired-call
     assert staging.slots_allocated == 1
     a = staging._rings[256][0]
-    staging.stage(w[:50], l[:50])
+    staging.stage(w[:50], l[:50])  # jaxlint: disable=missing-finally-for-paired-call
     assert staging.slots_allocated == 2
     b = staging._rings[256][1]
     assert a is not b
@@ -304,8 +306,9 @@ def test_staging_rotation_into_in_flight_slot_raises():
     thread would otherwise hit), and release() past empty raises too."""
     staging = ingest.StagingBuffers(P, min_bucket=256)
     w, l = make_matches(20, seed=6)
-    staging.stage(w, l)
-    staging.stage(w, l)
+    # Deliberate: both slots must be held in flight to force the guard.
+    staging.stage(w, l)  # jaxlint: disable=missing-finally-for-paired-call
+    staging.stage(w, l)  # jaxlint: disable=missing-finally-for-paired-call
     with pytest.raises(RuntimeError, match="in-flight"):
         staging.stage(w, l)
     # Releasing makes the same rotation legal again.
@@ -323,7 +326,9 @@ def test_staged_pack_equals_pack_batch():
     one jit cache entry per bucket."""
     w, l = make_matches(77, seed=4)
     staging = ingest.StagingBuffers(P, min_bucket=256)
-    staged = staging.stage(w, l)
+    # Deliberately left in flight: the staged arrays are compared below
+    # and the buffers object dies with the test.
+    staged = staging.stage(w, l)  # jaxlint: disable=resource-leaked-on-exception
     cold = engine.pack_batch(P, w, l, min_bucket=256)
     for got, want in zip(staged[:5], cold[:5]):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
@@ -346,12 +351,57 @@ def test_steady_state_ingest_causes_zero_recompiles():
     assert eng._staging.slots_allocated == slots_after_warmup
 
 
+def test_failed_pack_abandons_the_acquired_slot():
+    """The exceptional-path regression the v4 lint audit surfaced: a
+    failure between _acquire and the PackedBatch return used to leave
+    the slot in flight forever — no dispatch would carry it, so no
+    release() would ever retire it, and after `depth` such failures the
+    bucket stalled every stage(). The abandon must hit the EXACT slot
+    (not the FIFO head, which mid-pack belongs to an older live
+    dispatch) and must leave the pool fully usable."""
+    staging = ingest.StagingBuffers(P, min_bucket=256)
+    w, l = make_matches(40, seed=9)
+    # An older dispatch is live: its slot is the FIFO head the failed
+    # pack must NOT retire.
+    staging.stage(w, l)  # jaxlint: disable=missing-finally-for-paired-call
+    head = staging._inflight[0]
+    real_argsort = np.argsort
+
+    def exploding_argsort(*args, **kwargs):
+        raise MemoryError("synthetic mid-pack failure")
+
+    np.argsort = exploding_argsort
+    try:
+        with pytest.raises(MemoryError, match="mid-pack"):
+            # Deliberate: this stage MUST fail mid-pack — the abandon
+            # path is the subject under test.
+            staging.stage(w[:10], l[:10])  # jaxlint: disable=missing-finally-for-paired-call
+    finally:
+        np.argsort = real_argsort
+    # The failed stage's slot was abandoned; the live dispatch's was not.
+    assert staging.in_flight() == 1
+    assert staging._inflight[0] is head
+    assert head.in_flight
+    # The pool still works: repeated stage/release cycles through the
+    # same bucket succeed — the rotation rewound onto the abandoned
+    # slot, so no spurious in-flight guard and no permanent stall.
+    # (FIFO: the first release retires `head`, the oldest dispatch.)
+    # Deliberate bare pairs: the slot mechanics ARE the subject here.
+    for n in (10, 40, 200):
+        staging.stage(w[:n], l[:n])  # jaxlint: disable=missing-finally-for-paired-call
+        staging.release()
+    staging.release()
+    assert staging.in_flight() == 0
+
+
 def test_staging_rejects_shallow_depth_and_bad_ids():
     with pytest.raises(ValueError, match="two slots"):
         ingest.StagingBuffers(P, depth=1)
     staging = ingest.StagingBuffers(P)
     with pytest.raises(ValueError, match="player ids"):
-        staging.stage([0, P], [1, 2])
+        # Validation rejects the batch BEFORE a slot is acquired, so
+        # there is nothing to release — statically indistinguishable.
+        staging.stage([0, P], [1, 2])  # jaxlint: disable=resource-leaked-on-exception
 
 
 # --- engine wiring ---------------------------------------------------------

@@ -71,7 +71,10 @@ def test_mutations_cover_every_policed_surface():
     abstract interpreter (the shape-lattice join, the recognized
     bucketing-op set, the taint sanitizer check), and since PR 13 the
     live ops plane (the sliding window's ring rotation, the SLO
-    burn-rate threshold direction, the /debug wire envelope)."""
+    burn-rate threshold direction, the /debug wire envelope), and since
+    PR 14 the jaxlint v4 lifecycle analyzer (the CFG's exception edge,
+    the terminal-state transition, the one-hop helper-release
+    credit)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -79,6 +82,8 @@ def test_mutations_cover_every_policed_surface():
         "arena/analysis/jaxlint.py",
         "arena/analysis/project.py",
         "arena/analysis/absint.py",
+        "arena/analysis/cfg.py",
+        "arena/analysis/lifecycle.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",
@@ -118,6 +123,8 @@ def _fake_sources_only(dest):
         "arena/analysis/jaxlint.py",
         "arena/analysis/project.py",
         "arena/analysis/absint.py",
+        "arena/analysis/cfg.py",
+        "arena/analysis/lifecycle.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",

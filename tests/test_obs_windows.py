@@ -314,11 +314,14 @@ def test_dead_rotator_raises_instead_of_hanging():
 
     win._snap_cumulative = boom  # instance shadow: next rotation dies
     win.start()
-    with pytest.raises(WindowError, match="rotation thread died"):
-        win.wait_for_rotation(rotations=1, timeout=10.0)
-    health = win.health()
-    assert health["error"] is not None
-    assert "snapshot exploded" in health["error"]
+    try:
+        with pytest.raises(WindowError, match="rotation thread died"):
+            win.wait_for_rotation(rotations=1, timeout=10.0)
+        health = win.health()
+        assert health["error"] is not None
+        assert "snapshot exploded" in health["error"]
+    finally:
+        win.close()
 
 
 def test_wait_for_rotation_without_thread_is_an_error():
