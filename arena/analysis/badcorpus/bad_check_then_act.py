@@ -1,0 +1,28 @@
+"""jaxlint corpus: a guarded field checked and acted on in two
+critical sections.
+
+`_seats` is `# guarded_by: _lock`, and every individual access here
+IS lock-held — PR 10's unguarded-shared-write has nothing to say. The
+race is between the sections: the check reads `seats` under the lock,
+releases it, and the act decrements from the STALE copy, so two
+threads that both saw `seats == 1` both book it. The check and the
+act must share one critical section (or re-read after re-acquiring).
+Rule: check-then-act-race.
+"""
+
+import threading
+
+
+class Booker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._seats = 8  # guarded_by: _lock
+
+    def book(self):
+        with self._lock:
+            seats = self._seats  # the check...
+        if seats > 0:  # ...acted on after the lock was released
+            with self._lock:
+                self._seats = seats - 1  # lost update: seats is stale
+            return True
+        return False

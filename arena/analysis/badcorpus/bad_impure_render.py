@@ -1,0 +1,20 @@
+"""jaxlint corpus: a `# pure-render(view)` that reads hidden state.
+
+`row` is declared a pure function of its parameters and the immutable
+`view` — the precondition a `(page, watermark)`-keyed byte cache
+needs. But it also reads `self._theme`: two renders at the same
+watermark can differ, so a cached page silently serves the wrong
+bytes after the theme changes. Rule: hidden-state-read-in-pure-render.
+"""
+
+
+class Leaderboard:
+    def __init__(self):
+        self._theme = "dark"
+
+    def row(self, view, p):  # pure-render(view)
+        return {
+            "player": p,
+            "rating": float(view.ratings[p]),
+            "theme": self._theme,  # hidden: not part of the view
+        }

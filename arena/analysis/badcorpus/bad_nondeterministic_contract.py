@@ -1,0 +1,23 @@
+"""jaxlint corpus: a `# deterministic` contract broken two hops down.
+
+`stamped_score` promises bit-identical outputs for identical inputs —
+the property a log-shipping replica needs to replay the applied_log
+bit-exactly. But its helper's helper reads the wall clock and the
+value flows into the returned score: two runs of the "same" replay
+now disagree. The one-hop analyzers would have missed this; the
+call-graph fixpoint does not. Rule: nondeterminism-in-deterministic-fn.
+"""
+
+import time
+
+
+def _jitter():
+    return time.time() % 1.0
+
+
+def _adjusted(base):
+    return base + _jitter()
+
+
+def stamped_score(base):  # deterministic
+    return _adjusted(base) * 2.0

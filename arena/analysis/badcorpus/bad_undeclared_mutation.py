@@ -1,0 +1,22 @@
+"""jaxlint corpus: a contract function with an undeclared write.
+
+`apply_round` declares `# deterministic; mutates: ratings` — callers
+(and the replica replay machinery) read that allowance as the COMPLETE
+write set. But its helper also bumps `rounds_applied`, so restoring
+`ratings` alone does not restore the object: the contract is lying
+about the state surface. Rule: undeclared-mutation-in-contract.
+"""
+
+
+class Rounds:
+    def __init__(self):
+        self.ratings = {}
+        self.rounds_applied = 0
+
+    def _bump(self):
+        self.rounds_applied += 1
+
+    def apply_round(self, deltas):  # deterministic; mutates: ratings
+        for player in deltas:
+            self.ratings[player] = self.ratings.get(player, 0.0) + 1.0
+        self._bump()

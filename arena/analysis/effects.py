@@ -1,0 +1,720 @@
+"""Interprocedural determinism & effect-contract analyzer (jaxlint v5).
+
+The `# deterministic` / `# pure-render(view)` comments on a def header
+(see `arena.analysis.project.parse_contract`) declare the function's
+effect contract. This module builds a PROJECT-WIDE effect-summary
+table — per function: the `self` attributes it reads and writes, the
+module globals it writes, and the nondeterministic sources whose
+values flow into its results, branches, or state writes — then
+propagates the summaries to a fixpoint over the call graph the symbol
+table can resolve (same-class `self.m()` calls, same-module and
+imported module functions). That closure is the upgrade over the
+v3/v4 analyzers' one-hop resolution: a wall-clock read three helpers
+deep still breaks a `# deterministic` promise at the annotated
+function. Four rules run on the result:
+
+- ``nondeterminism-in-deterministic-fn``: a `# deterministic`
+  function's closure consumes wall-clock time, unseeded RNG,
+  set/`popitem` iteration order, `id()`, `os.environ`, or thread
+  identity — and the value flows into a return, a branch, a call
+  argument, or a state write (a source whose value is discarded is
+  not a finding).
+- ``hidden-state-read-in-pure-render``: a `# pure-render(view)`
+  function reads `self` state (or consumes a nondeterministic source)
+  — its result must depend only on its parameters and the named
+  immutable view, the exact precondition a `(page, watermark)`-keyed
+  byte cache needs.
+- ``check-then-act-race``: a `# guarded_by:` field is read into a
+  local under its lock, the lock is released, and a later write (or a
+  branch that drives writes) consumes the stale local without
+  re-acquiring the lock and re-reading — path-sensitive over the
+  PR 14 exception-edge CFG. This extends PR 10's lock discipline from
+  "hold the lock" to "hold it atomically": every write in the racy
+  shape can be individually lock-held and the interleaving still
+  loses updates. Rebinding the local (the re-read-under-the-lock
+  idiom) clears the stale fact — that IS the fix shape.
+- ``undeclared-mutation-in-contract`` (warning): a contract-annotated
+  function's closure writes state not listed in its optional
+  `# mutates:` allowance — the contract documents the write set, so
+  an undeclared write is either a bug or a stale annotation.
+
+No-claim semantics, like everything in jaxlint: calls the table
+cannot resolve (attribute receivers like `self._eng.ingest_async`,
+dynamic dispatch) contribute nothing to the closure; a read reached
+through a local alias is not a guarded-field read. Seeded randomness
+(`jax.random` key-passing, `Random(seed)`, `default_rng(seed)`) is
+deterministic and never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+
+from arena.analysis.cfg import K_STMT, build_cfg
+from arena.analysis.jaxlint import rule
+from arena.analysis.project import (
+    LOCKED_SUFFIX,
+    _self_attr_writes,
+    dotted,
+    make_lock_resolver,
+    scan_function,
+)
+
+RULE_NONDET = "nondeterminism-in-deterministic-fn"
+RULE_HIDDEN = "hidden-state-read-in-pure-render"
+RULE_RACE = "check-then-act-race"
+RULE_UNDECLARED = "undeclared-mutation-in-contract"
+
+_RULE_NAMES = (RULE_NONDET, RULE_HIDDEN, RULE_RACE, RULE_UNDECLARED)
+
+# Method tails whose call on `self.X` mutates the attribute in place —
+# the write-effect spelling of `self.X.append(...)`. Deliberately NOT
+# `release`/`stage`/`flush`: those are protocol verbs on owned
+# objects, not container mutations of this object's state.
+_MUTATOR_TAILS = frozenset({
+    "append", "appendleft", "add", "pop", "popleft", "popitem", "clear",
+    "update", "extend", "insert", "remove", "discard", "setdefault",
+    "sort", "reverse",
+})
+
+# --- nondeterministic sources ----------------------------------------------
+
+_WALLCLOCK = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "time.perf_counter_ns", "time.monotonic_ns",
+})
+_THREAD_IDENT = frozenset({
+    "threading.get_ident", "threading.current_thread",
+    "threading.active_count",
+})
+
+
+def _nondet_call_label(fname: str, call: ast.Call) -> str | None:
+    """Label when this resolved call name is a nondeterministic source,
+    else None. Seeded constructions (`Random(7)`, `default_rng(0)`,
+    `jax.random.*` key-passing) are deterministic by design."""
+    if fname in _WALLCLOCK:
+        return f"wall-clock `{fname}()`"
+    parts = fname.split(".")
+    tail = parts[-1]
+    if tail in ("now", "utcnow") and "datetime" in parts:
+        return f"wall-clock `{fname}()`"
+    if fname in _THREAD_IDENT:
+        return f"thread-identity `{fname}()`"
+    if fname == "id":
+        return "`id()` (address-dependent ordering)"
+    if fname in ("os.getenv", "os.environ.get"):
+        return f"`{fname}()` (environment-dependent)"
+    if tail == "default_rng" and not call.args and not call.keywords:
+        return f"unseeded `{fname}()`"
+    if tail == "popitem":
+        return "`.popitem()` iteration order"
+    root = parts[0]
+    if root == "random" and len(parts) > 1 and not tail[0].isupper():
+        return f"unseeded RNG `{fname}()`"
+    if (root in ("np", "numpy") and len(parts) > 2 and parts[1] == "random"
+            and not tail[0].isupper() and tail != "default_rng"):
+        return f"unseeded RNG `{fname}()`"
+    return None
+
+
+def _is_set_expr(node) -> bool:
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func)
+        return fname in ("set", "frozenset")
+    return False
+
+
+class _Summary:
+    """One function's effect summary; also the closure record (the
+    fixpoint merges summaries with `|`)."""
+
+    __slots__ = ("self_reads", "self_writes", "global_writes", "nondet")
+
+    def __init__(self, self_reads=frozenset(), self_writes=frozenset(),
+                 global_writes=frozenset(), nondet=frozenset()):
+        self.self_reads = self_reads
+        self.self_writes = self_writes
+        self.global_writes = global_writes
+        self.nondet = nondet  # frozenset of (label, origin_key, lineno)
+
+    def __or__(self, other):
+        return _Summary(
+            self.self_reads | other.self_reads,
+            self.self_writes | other.self_writes,
+            self.global_writes | other.global_writes,
+            self.nondet | other.nondet,
+        )
+
+    def __eq__(self, other):
+        return (self.self_reads == other.self_reads
+                and self.self_writes == other.self_writes
+                and self.global_writes == other.global_writes
+                and self.nondet == other.nondet)
+
+
+def _bound_names(tgt):
+    """Plain local names a binding target (re)binds — tuples unpacked,
+    attribute/subscript targets skipped (they mutate, not rebind)."""
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _bound_names(elt)
+    elif isinstance(tgt, ast.Starred):
+        yield from _bound_names(tgt.value)
+    elif isinstance(tgt, ast.Name):
+        yield tgt.id
+
+
+def _assign_targets(stmt):
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return [stmt.target]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.target]
+    if isinstance(stmt, ast.Delete):
+        return stmt.targets
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.optional_vars for i in stmt.items
+                if i.optional_vars is not None]
+    return []
+
+
+# --- per-function raw summaries --------------------------------------------
+
+
+def _raw_summary(fn_node, origin_key, method_names):
+    """(summary, callee keys) from one walk over the function INCLUDING
+    nested def bodies (an inner `step` runs as part of the enclosing
+    kernel — its effects are the enclosing function's effects).
+    `method_names` filters method references out of self reads so
+    `self.helper()` is a call edge, not a state read."""
+    self_reads, self_writes, global_writes = set(), set(), set()
+    callees = []
+    sources = []  # (label, node)
+    src_index = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            if (node.value.id == "self" and isinstance(node.ctx, ast.Load)
+                    and node.attr not in method_names):
+                self_reads.add(node.attr)
+            if dotted(node) == "os.environ":
+                src_index[id(node)] = len(sources)
+                sources.append(("`os.environ` (environment-dependent)", node))
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            for attr, _tgt in _self_attr_writes(node):
+                self_writes.add(attr)
+        if isinstance(node, ast.Global):
+            global_writes.update(node.names)
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname is not None:
+                callees.append(fname)
+                label = _nondet_call_label(fname, node)
+                if label is not None:
+                    src_index[id(node)] = len(sources)
+                    sources.append((label, node))
+                parts = fname.split(".")
+                if (parts[0] == "self" and len(parts) == 3
+                        and parts[2] in _MUTATOR_TAILS):
+                    self_writes.add(parts[1])
+    direct = set()  # source indices consumed regardless of data flow
+    for node in ast.walk(fn_node):
+        it = None
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            it = node.iter
+        elif isinstance(node, ast.comprehension):
+            it = node.iter
+        if it is not None and _is_set_expr(it):
+            src_index[id(it)] = len(sources)
+            sources.append(("set iteration order", it))
+            direct.add(len(sources) - 1)
+    consumed = direct | _consumed_sources(fn_node, src_index, global_writes)
+    nondet = frozenset(
+        (label, origin_key, node.lineno)
+        for i, (label, node) in enumerate(sources) if i in consumed
+    )
+    summary = _Summary(frozenset(self_reads), frozenset(self_writes),
+                       frozenset(global_writes), nondet)
+    return summary, callees
+
+
+def _consumed_sources(fn_node, src_index, global_names):
+    """Source indices whose VALUE flows into a sink: a return/yield, an
+    if/while test, a call argument, or the RHS of a self-attribute or
+    global write — via a small tainted-locals fixpoint. A source read
+    and discarded is noise, not nondeterminism."""
+    if not src_index:
+        return set()
+    taint = {}  # local name -> set of source indices
+
+    def expr_sources(expr):
+        out = set()
+        for n in ast.walk(expr):
+            idx = src_index.get(id(n))
+            if idx is not None:
+                out.add(idx)
+            if isinstance(n, ast.Name) and n.id in taint:
+                out |= taint[n.id]
+        return out
+
+    assigns = [
+        n for n in ast.walk(fn_node)
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+        and n.value is not None
+    ]
+    changed = True
+    while changed:
+        changed = False
+        for stmt in assigns:
+            flowing = expr_sources(stmt.value)
+            if not flowing:
+                continue
+            for tgt in _assign_targets(stmt):
+                for name in _bound_names(tgt):
+                    cur = taint.get(name, set())
+                    if not flowing <= cur:
+                        taint[name] = cur | flowing
+                        changed = True
+    consumed = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Return) and n.value is not None:
+            consumed |= expr_sources(n.value)
+        elif isinstance(n, (ast.Yield, ast.YieldFrom)) and n.value is not None:
+            consumed |= expr_sources(n.value)
+        elif isinstance(n, (ast.If, ast.While)):
+            consumed |= expr_sources(n.test)
+        elif isinstance(n, ast.Call):
+            for a in n.args:
+                consumed |= expr_sources(a)
+            for kw in n.keywords:
+                consumed |= expr_sources(kw.value)
+        elif isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            if n.value is None:
+                continue
+            keys = set()
+            for tgt in _assign_targets(n):
+                key = dotted(tgt)
+                if key is not None:
+                    keys.add(key)
+            if any(k.startswith("self.") for k in keys) or any(
+                k in global_names for k in keys
+            ):
+                consumed |= expr_sources(n.value)
+    return consumed
+
+
+# --- call-graph resolution + fixpoint --------------------------------------
+
+
+def _resolve_callee(mod, cls_name, fname, project):
+    """Global summary key (`module::qualname`) for a call spelled
+    `fname` inside `mod` (method of `cls_name` when not None), or None
+    when the table cannot resolve it — no claim, no edge."""
+    parts = fname.split(".")
+    if parts[0] == "self":
+        if cls_name is not None and len(parts) == 2:
+            cls = mod.classes.get(cls_name)
+            if cls is not None and parts[1] in cls.methods:
+                return f"{mod.name}::{cls_name}.{parts[1]}"
+        return None
+    if fname in mod.functions:
+        return f"{mod.name}::{fname}"
+    if project is None:
+        return None
+    for i in range(len(parts), 0, -1):
+        head = ".".join(parts[:i])
+        if head not in mod.imports:
+            continue
+        src_name, symbol = mod.imports[head]
+        rest = parts[i:]
+        if symbol is not None:
+            rest = [symbol] + rest
+        src = project.module(src_name)
+        if src is None and rest:
+            src = project.module(f"{src_name}.{rest[0]}")
+            rest = rest[1:]
+        if src is not None and len(rest) == 1 and rest[0] in src.functions:
+            return f"{src.name}::{rest[0]}"
+    return None
+
+
+def _iter_module_functions(mod):
+    """(qualname, fn_node, cls_name) over a module's registered
+    functions and methods — the summary table's key space."""
+    for fname, fn_node in mod.functions.items():
+        yield fname, fn_node, None
+    for cls in mod.classes.values():
+        for mname, mnode in cls.methods.items():
+            yield f"{cls.name}.{mname}", mnode, cls.name
+
+
+def _build_summaries(mods, project):
+    """key -> closure summary over every function the table registers,
+    propagated to a fixpoint over the resolvable call edges."""
+    raw, calls = {}, {}
+    for mod in mods:
+        for qualname, fn_node, cls_name in _iter_module_functions(mod):
+            key = f"{mod.name}::{qualname}"
+            methods = (set(mod.classes[cls_name].methods)
+                       if cls_name is not None else frozenset())
+            summary, callee_names = _raw_summary(fn_node, key, methods)
+            raw[key] = summary
+            edges = set()
+            for fname in callee_names:
+                target = _resolve_callee(mod, cls_name, fname, project)
+                if target is not None and target != key:
+                    edges.add(target)
+            calls[key] = frozenset(edges)
+    closure = dict(raw)
+    changed = True
+    while changed:  # to fixpoint: one call-graph hop per pass
+        changed = False
+        prev = dict(closure)
+        for key in closure:
+            merged = raw[key]
+            for callee in calls[key]:
+                if callee in prev:
+                    merged = merged | prev[callee]
+            if merged != closure[key]:
+                closure[key] = merged
+                changed = True
+    return closure
+
+
+_SUMMARY_CACHE_LOCK = threading.Lock()
+
+
+def _project_summaries(ctx):
+    """The project-wide closure table, computed once per ProjectTable
+    and cached on it (lock-guarded: `--jobs` runs the per-module rule
+    pass on a thread pool and every module shares this table)."""
+    project = ctx.project
+    if project is None:
+        return _build_summaries([ctx.symbols], None)
+    with _SUMMARY_CACHE_LOCK:
+        cached = getattr(project, "_effects_summaries", None)
+        if cached is None:
+            cached = _build_summaries(list(project.modules.values()), project)
+            project._effects_summaries = cached
+        return cached
+
+
+# --- the module pass -------------------------------------------------------
+
+
+class _ModuleEffects:
+    """One module's effect pass: contract checks against the project
+    closure table + the path-sensitive check-then-act analysis,
+    findings bucketed per rule."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.findings = {name: [] for name in _RULE_NAMES}
+        self._seen = set()
+
+    def run(self):
+        summaries = _project_summaries(self.ctx)
+        self._check_contracts(summaries)
+        self._check_then_act()
+        return self
+
+    def _emit(self, rule_name, node, message):
+        key = (rule_name, node.lineno, node.col_offset)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings[rule_name].append(
+            self.ctx.finding(node, rule_name, message)
+        )
+
+    # -- contract checks ----------------------------------------------------
+
+    def _contract_node(self, qualname):
+        sym = self.ctx.symbols
+        if "." in qualname:
+            cls_name, mname = qualname.split(".", 1)
+            cls = sym.classes.get(cls_name)
+            if cls is not None and mname in cls.methods:
+                return cls.methods[mname], cls
+            return None, None
+        return sym.functions.get(qualname), None
+
+    def _check_contracts(self, summaries):
+        sym = self.ctx.symbols
+        for qualname in sorted(sym.contracts):
+            contract = sym.contracts[qualname]
+            fn_node, cls_sym = self._contract_node(qualname)
+            if fn_node is None:
+                continue
+            closure = summaries.get(f"{sym.name}::{qualname}")
+            if closure is None:
+                continue
+            if contract["deterministic"]:
+                for label, origin, line in sorted(closure.nondet):
+                    self._emit(
+                        RULE_NONDET, fn_node,
+                        f"`{qualname}` is declared `# deterministic` but its "
+                        f"call-graph closure consumes {label} in `{origin}` "
+                        f"(line {line}) — same inputs can produce different "
+                        "outputs or state writes",
+                    )
+            view = contract["pure_render"]
+            if view is not None:
+                self._check_pure_render(qualname, fn_node, cls_sym, view,
+                                        closure)
+            undeclared = sorted(
+                (closure.self_writes | closure.global_writes)
+                - contract["mutates"]
+            )
+            if undeclared:
+                names = ", ".join(f"`{n}`" for n in undeclared)
+                self._emit(
+                    RULE_UNDECLARED, fn_node,
+                    f"`{qualname}`'s call-graph closure writes {names} not "
+                    "listed in its `# mutates:` allowance — declare the "
+                    "write set or stop writing it",
+                )
+
+    def _check_pure_render(self, qualname, fn_node, cls_sym, view, closure):
+        args = fn_node.args
+        params = {a.arg for a in (args.posonlyargs + args.args
+                                  + args.kwonlyargs)}
+        if args.vararg is not None:
+            params.add(args.vararg.arg)
+        if args.kwarg is not None:
+            params.add(args.kwarg.arg)
+        methods = set(cls_sym.methods) if cls_sym is not None else set()
+        hidden_attrs = set()
+        for node in ast.walk(fn_node):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            root = node.value.id
+            if root == view or (root != "self" and root in params):
+                # Reads through the named view or any other parameter
+                # ARE the contract's declared inputs — never hidden.
+                continue
+            if root == "self" and node.attr not in methods:
+                if node.attr not in hidden_attrs:
+                    hidden_attrs.add(node.attr)
+                    self._emit(
+                        RULE_HIDDEN, node,
+                        f"`{qualname}` is `# pure-render({view})` but reads "
+                        f"hidden state `self.{node.attr}` — the render must "
+                        f"depend only on its parameters and `{view}`, or a "
+                        "byte cache keyed on the view serves stale pages",
+                    )
+        if view != "self":
+            for attr in sorted(closure.self_reads - hidden_attrs):
+                self._emit(
+                    RULE_HIDDEN, fn_node,
+                    f"`{qualname}` is `# pure-render({view})` but its "
+                    f"call-graph closure reads hidden state `self.{attr}` — "
+                    f"the render must depend only on its parameters and "
+                    f"`{view}`",
+                )
+        for label, origin, line in sorted(closure.nondet):
+            self._emit(
+                RULE_HIDDEN, fn_node,
+                f"`{qualname}` is `# pure-render({view})` but its closure "
+                f"consumes {label} in `{origin}` (line {line}) — a "
+                "nondeterministic render cannot be cached by view",
+            )
+
+    # -- check-then-act -----------------------------------------------------
+
+    def _check_then_act(self):
+        sym = self.ctx.symbols
+        for cls in sym.classes.values():
+            if not cls.guarded or not cls.lock_attrs:
+                continue
+            for mname, mnode in cls.methods.items():
+                if mname == "__init__" or self.ctx.is_traced_def(mnode):
+                    continue
+                self._cta_function(cls, mnode)
+
+    def _cta_function(self, cls, fn_node):
+        sym = self.ctx.symbols
+        resolver = make_lock_resolver(sym, cls)
+        held0 = ()
+        if fn_node.name.endswith(LOCKED_SUFFIX):
+            held0 = tuple(sorted(cls.lock_ids()))
+        _acquired, _edges, stmts = scan_function(fn_node, resolver, held0)
+        held_by_stmt = {id(stmt): frozenset(held) for stmt, held in stmts}
+        cfg = build_cfg(fn_node)
+
+        def node_state(node, state):
+            """Transfer: escalate escaped facts by this statement's
+            held set, kill rebound locals, gen fresh guarded reads."""
+            stmt = node.stmt
+            if (node.kind != K_STMT or stmt is None
+                    or not isinstance(stmt, ast.stmt)
+                    or isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))):
+                return state
+            held = held_by_stmt.get(id(stmt), frozenset())
+            facts = {
+                (name, attr, lock, escaped or lock not in held)
+                for name, attr, lock, escaped in state
+            }
+            rebound = set()
+            for tgt in _assign_targets(stmt):
+                rebound.update(_bound_names(tgt))
+            if rebound:
+                # Rebinding is the re-check credit: a fresh read under
+                # a re-acquired lock replaces the stale fact entirely.
+                facts = {f for f in facts if f[0] not in rebound}
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                value = dotted(stmt.value)
+                if (value is not None and value.startswith("self.")
+                        and value.count(".") == 1):
+                    attr = value.split(".", 1)[1]
+                    lockname = cls.guarded.get(attr)
+                    if lockname is not None:
+                        lock_id = f"{sym.name}.{cls.name}.{lockname}"
+                        if lock_id in held:
+                            facts.add((stmt.targets[0].id, attr, lock_id,
+                                       False))
+            return frozenset(facts)
+
+        in_states = [None] * len(cfg.nodes)
+        in_states[cfg.entry_idx] = frozenset()
+        work = [cfg.entry_idx]
+        while work:
+            idx = work.pop()
+            out = node_state(cfg.nodes[idx], in_states[idx])
+            for succ, _kind in cfg.nodes[idx].succs:
+                prev = in_states[succ]
+                merged = out if prev is None else prev | out
+                if merged != prev:
+                    in_states[succ] = merged
+                    work.append(succ)
+        self._cta_report(cls, fn_node, cfg, in_states, held_by_stmt)
+
+    def _cta_report(self, cls, fn_node, cfg, in_states, held_by_stmt):
+        reported = set()
+        for node in cfg.nodes:
+            stmt = node.stmt
+            state = in_states[node.idx]
+            if (state is None or node.kind != K_STMT or stmt is None
+                    or not isinstance(stmt, ast.stmt)
+                    or id(stmt) in reported):
+                continue
+            held = held_by_stmt.get(id(stmt), frozenset())
+            stale = {
+                name: (attr, lock)
+                for name, attr, lock, escaped in state
+                if escaped or lock not in held
+            }
+            if not stale:
+                continue
+            consumed = self._cta_consumption(cls, stmt, stale)
+            if consumed is None:
+                continue
+            name, attr, verb = consumed
+            reported.add(id(stmt))
+            lockname = cls.guarded[attr]
+            self._emit(
+                RULE_RACE, stmt,
+                f"`{name}` was read from `self.{attr}` under "
+                f"`self.{lockname}` but the lock was released before this "
+                f"{verb} consumes it — re-acquire `self.{lockname}` and "
+                f"re-read `self.{attr}` (the check and the act must share "
+                "one critical section)",
+            )
+
+    def _cta_consumption(self, cls, stmt, stale):
+        """(local, attr, verb) when this statement acts on a stale
+        guarded read: a self-state write whose RHS reads it, or an
+        if/while whose test reads it and whose body writes self state
+        or calls a same-class method."""
+        if (isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+                and stmt.value is not None and _self_attr_writes(stmt)):
+            for n in ast.walk(stmt.value):
+                if isinstance(n, ast.Name) and n.id in stale:
+                    return n.id, stale[n.id][0], "write"
+        if isinstance(stmt, (ast.If, ast.While)):
+            hit = None
+            for n in ast.walk(stmt.test):
+                if isinstance(n, ast.Name) and n.id in stale:
+                    hit = n.id
+                    break
+            if hit is None:
+                return None
+            for body_stmt in stmt.body + stmt.orelse:
+                for sub in ast.walk(body_stmt):
+                    if (isinstance(sub, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign))
+                            and _self_attr_writes(sub)):
+                        return hit, stale[hit][0], "branch"
+                    if isinstance(sub, ast.Call):
+                        fname = dotted(sub.func)
+                        if fname is None or not fname.startswith("self."):
+                            continue
+                        parts = fname.split(".")
+                        if (len(parts) == 2 and parts[1] in cls.methods) or (
+                            len(parts) == 3 and parts[2] in _MUTATOR_TAILS
+                        ):
+                            return hit, stale[hit][0], "branch"
+        return None
+
+
+def _analysis(ctx):
+    cached = getattr(ctx, "_effects_findings", None)
+    if cached is None:
+        cached = _ModuleEffects(ctx).run().findings
+        ctx._effects_findings = cached
+    return cached
+
+
+# --- the four v5 rules -------------------------------------------------------
+
+
+@rule(
+    RULE_NONDET,
+    "a `# deterministic` function's call-graph closure consumes wall-clock, "
+    "unseeded RNG, set/popitem ordering, id(), os.environ, or thread "
+    "identity and lets the value flow into results or state writes",
+    severity="error",
+)
+def _check_nondet_contract(ctx):
+    yield from _analysis(ctx)[RULE_NONDET]
+
+
+@rule(
+    RULE_HIDDEN,
+    "a `# pure-render(view)` function reads self state (or consumes a "
+    "nondeterministic source) — the render must be a pure function of its "
+    "parameters and the named immutable view, or view-keyed caching breaks",
+    severity="error",
+)
+def _check_hidden_state_read(ctx):
+    yield from _analysis(ctx)[RULE_HIDDEN]
+
+
+@rule(
+    RULE_RACE,
+    "a `# guarded_by:` field read under its lock, released, then consumed "
+    "by a write or write-driving branch without re-acquiring and re-reading "
+    "— the check and the act must share one critical section",
+    severity="error",
+)
+def _check_check_then_act(ctx):
+    yield from _analysis(ctx)[RULE_RACE]
+
+
+@rule(
+    RULE_UNDECLARED,
+    "a contract-annotated function's closure writes state not listed in its "
+    "`# mutates:` allowance — declare the write set or stop writing it",
+    severity="warning",
+)
+def _check_undeclared_mutation(ctx):
+    yield from _analysis(ctx)[RULE_UNDECLARED]

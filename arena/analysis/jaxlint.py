@@ -73,6 +73,7 @@ from __future__ import annotations
 
 import argparse
 import ast
+import concurrent.futures
 import dataclasses
 import io
 import json
@@ -986,13 +987,18 @@ def iter_python_files(paths):
     return iter(files)
 
 
-def lint_paths(paths, keep_suppressed: bool = False, rules=None) -> list[Finding]:
+def lint_paths(paths, keep_suppressed: bool = False, rules=None,
+               jobs: int = 1) -> list[Finding]:
     """The two-pass driver: pass 1 parses EVERY file and builds the
     project-wide symbol table; pass 2 runs the rules per module with
     that table in scope — so a rule looking at module B can resolve a
     mesh or a lock defined in module A. `rules` selects a registry
-    subset by name (None = all). Raises `PathError` carrying EVERY
-    missing/unreadable target after the whole walk."""
+    subset by name (None = all). `jobs` fans pass 2 over a thread
+    pool (pass 1 stays serial — the symbol table is shared state);
+    results are collected in submission order and sorted identically,
+    so parallel findings are bit-identical to serial. Raises
+    `PathError` carrying EVERY missing/unreadable target after the
+    whole walk."""
     selected = _select_rules(rules)
     findings = []
     contexts = []
@@ -1017,7 +1023,17 @@ def lint_paths(paths, keep_suppressed: bool = False, rules=None) -> list[Finding
     for ctx in contexts:
         ctx.project = table
         ctx.siblings = siblings
-        findings.extend(_apply_rules(ctx, keep_suppressed, selected))
+    if jobs > 1 and len(contexts) > 1:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=jobs) as pool:
+            per_ctx = pool.map(
+                lambda ctx: _apply_rules(ctx, keep_suppressed, selected),
+                contexts,
+            )
+            for batch in per_ctx:
+                findings.extend(batch)
+    else:
+        for ctx in contexts:
+            findings.extend(_apply_rules(ctx, keep_suppressed, selected))
     return _sorted_findings(findings)
 
 
@@ -1138,9 +1154,17 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--baseline", metavar="FILE",
         help="if FILE exists: report only findings NOT recorded in it "
-        "(keyed rule+path+message — tolerant of line drift). If FILE "
-        "does not exist: write the current findings to it and exit 0, "
-        "so a new rule can land on a dirty tree without flag-day fixes.",
+        "(keyed rule+path+message — tolerant of line drift; a finding "
+        "from a rule the baseline never ran is always reported). If "
+        "FILE does not exist: write the current findings to it and "
+        "exit 0, so a new rule can land on a dirty tree without "
+        "flag-day fixes.",
+    )
+    parser.add_argument(
+        "--jobs", type=int, default=1, metavar="N",
+        help="fan the per-file rule pass over N threads after the "
+        "serial symbol-table pass; findings are bit-identical to the "
+        "serial run (collected in submission order, same final sort)",
     )
     args = parser.parse_args(argv)
     if args.list_rules:
@@ -1161,12 +1185,17 @@ def main(argv=None) -> int:
             print(f"jaxlint: {exc}", file=sys.stderr)
             return 2
         selected = [name for name in selected if name not in disabled]
+    if args.jobs < 1:
+        print(f"jaxlint: --jobs must be >= 1, got {args.jobs}",
+              file=sys.stderr)
+        return 2
     targets = args.paths or default_targets()
     try:
         findings = lint_paths(
             targets,
             keep_suppressed=(args.format in ("json", "sarif")),
             rules=selected,
+            jobs=args.jobs,
         )
     except PathError as exc:
         # EVERY bad path gets its own line (rc 2 covers them all): a
@@ -1189,8 +1218,9 @@ def main(argv=None) -> int:
         bl_path = pathlib.Path(args.baseline)
         if bl_path.exists():
             try:
-                known = json.loads(bl_path.read_text(encoding="utf-8"))
-                known = set(known["findings"])
+                data = json.loads(bl_path.read_text(encoding="utf-8"))
+                known = set(data["findings"])
+                covered = data.get("rules", "all")
             except (OSError, ValueError, KeyError, TypeError) as exc:
                 print(
                     f"jaxlint: --baseline {args.baseline}: not a baseline "
@@ -1198,17 +1228,33 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
                 return 2
-            findings = [f for f in findings if baseline_key(f) not in known]
+            # Filtering composes AFTER registry subsetting, and only
+            # rules the baseline actually RAN can suppress: a finding
+            # from a rule outside the baseline's recorded coverage was
+            # never assessed at write time, so its absence from the
+            # key set means nothing (legacy baselines without a
+            # "rules" key were written by full-registry runs).
+            covered_set = None if covered == "all" else set(covered)
+            findings = [
+                f for f in findings
+                if (covered_set is not None and f.rule not in covered_set)
+                or baseline_key(f) not in known
+            ]
         else:
             # First run: record the dirty tree and succeed. Suppressed
             # findings are already acknowledged in-source — recording
             # them too would mask the suppression comment ever being
-            # removed.
+            # removed. The registry subset in effect is recorded as
+            # the baseline's coverage, so a later wider run knows
+            # which rules' findings this file can legitimately mute.
             keys = sorted(
                 {baseline_key(f) for f in findings if not f.suppressed}
             )
             bl_path.write_text(
-                json.dumps({"findings": keys}, indent=2) + "\n",
+                json.dumps({
+                    "findings": keys,
+                    "rules": "all" if selected is None else sorted(selected),
+                }, indent=2) + "\n",
                 encoding="utf-8",
             )
             print(
@@ -1241,6 +1287,7 @@ def main(argv=None) -> int:
 from arena.analysis import concurrency as _concurrency  # noqa: E402,F401
 from arena.analysis import absint as _absint  # noqa: E402,F401
 from arena.analysis import lifecycle as _lifecycle  # noqa: E402,F401
+from arena.analysis import effects as _effects  # noqa: E402,F401
 
 
 if __name__ == "__main__":

@@ -85,6 +85,57 @@ def parse_protocols(comment_text):
             terminal.add(spec)
     return pairs, terminal
 
+# The effect-contract vocabulary (jaxlint v5), mirroring protocol: a
+# comment on a DEF header declares the function's contract. Clauses are
+# `;`-separated so one comment can carry a contract plus an allowance:
+#
+#     # deterministic
+#     # deterministic; mutates: _store, ratings
+#     # pure-render(view)
+#
+# `deterministic` promises same inputs => bit-identical outputs and
+# state writes (no wall clock, unseeded RNG, set/popitem iteration
+# order, id(), os.environ, or thread identity flowing into results or
+# writes, checked through the call-graph fixpoint closure by
+# `effects.py`). `pure-render(NAME)` promises the result depends only
+# on the parameters and the named immutable view argument. `mutates:`
+# lists the self attributes / module globals the closure is ALLOWED to
+# write. The clause anchors (`^` or `;`) keep prose comments that
+# merely contain the word "deterministic" from becoming contracts.
+DETERMINISTIC_RE = re.compile(r"(?:^|;)\s*deterministic\s*(?:$|;)")
+PURE_RENDER_RE = re.compile(
+    r"(?:^|;)\s*pure-render\(\s*([A-Za-z_][A-Za-z0-9_]*)\s*\)\s*(?:$|;)"
+)
+MUTATES_RE = re.compile(
+    r"(?:^|;)\s*mutates:\s*"
+    r"([A-Za-z_][A-Za-z0-9_]*(?:\s*,\s*[A-Za-z_][A-Za-z0-9_]*)*)"
+)
+
+
+def parse_contract(comment_text):
+    """A contract record parsed from one comment's text, or None when
+    the comment declares nothing. The record is a dict with keys
+    `deterministic` (bool), `pure_render` (view parameter name or
+    None), and `mutates` (frozenset of allowed write names, meaningful
+    only alongside a contract). Malformed clauses are simply not
+    matched — never a parse error."""
+    deterministic = bool(DETERMINISTIC_RE.search(comment_text))
+    render = PURE_RENDER_RE.search(comment_text)
+    mutates = MUTATES_RE.search(comment_text)
+    if not deterministic and render is None:
+        return None
+    allowed = frozenset()
+    if mutates is not None:
+        allowed = frozenset(
+            name.strip() for name in mutates.group(1).split(",")
+        )
+    return {
+        "deterministic": deterministic,
+        "pure_render": render.group(1) if render is not None else None,
+        "mutates": allowed,
+    }
+
+
 # threading constructors whose assignment makes an attribute "a lock"
 # (a Condition wraps a lock; acquiring it IS acquiring the lock).
 LOCK_FACTORY_TAILS = frozenset({"Lock", "RLock", "Condition", "Semaphore"})
@@ -173,6 +224,7 @@ class ModuleSymbols:
     func_locks: dict = dataclasses.field(default_factory=dict)  # qualname -> set[id]
     lock_edges: list = dataclasses.field(default_factory=list)  # (outer, inner, line, col)
     lock_calls: list = dataclasses.field(default_factory=list)  # (held, callee, line, col)
+    contracts: dict = dataclasses.field(default_factory=dict)  # qualname -> contract
 
 
 # --- collection helpers ----------------------------------------------------
@@ -477,6 +529,15 @@ def module_symbols(path: str, tree, comments: dict) -> ModuleSymbols:
 
     # Lock-order graph: direct acquisitions + calls made while holding.
     def scan_scope(fn_node, cls, qualname):
+        # `# deterministic` / `# pure-render(view)` sits on the def
+        # header (same line as the `def` keyword, or a continuation
+        # line of a wrapped signature before the body) — the same
+        # placement rule as the class-header `# protocol:` scan.
+        first_body_line = fn_node.body[0].lineno if fn_node.body else fn_node.lineno
+        for ln in range(fn_node.lineno, max(first_body_line, fn_node.lineno + 1)):
+            contract = parse_contract(comments.get(ln, ""))
+            if contract is not None:
+                sym.contracts[qualname] = contract
         resolver = make_lock_resolver(sym, cls)
         held0 = ()
         if cls is not None and fn_node.name.endswith(LOCKED_SUFFIX):

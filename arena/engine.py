@@ -321,7 +321,7 @@ class ArenaEngine:  # protocol: shutdown
             self.matches_applied = store.num_matches
         return self.ratings
 
-    def update(self, winners, losers):
+    def update(self, winners, losers):  # deterministic; mutates: _store, ratings, matches_applied
         """Ingest one batch of outcomes and apply one batched Elo round."""
         self._drain_pipeline()
         # Root span: this batch's trace id — every nested stage span
@@ -356,7 +356,7 @@ class ArenaEngine:  # protocol: shutdown
             finally:
                 self._staging.release()
 
-    def ingest(self, winners, losers):
+    def ingest(self, winners, losers):  # deterministic; mutates: _store, _staging, ratings, matches_applied
         """`update` on the incremental path: the batch is packed
         through reusable double-buffered staging slots (zero host
         allocations and zero new jit compiles in steady state) and

@@ -340,7 +340,7 @@ class FrontDoor:
 
     # --- the merge worker ---------------------------------------------
 
-    def _pop_next_locked(self):
+    def _pop_next_locked(self):  # deterministic; mutates: _buffer, _summary, _summary_matches, _next_apply, _producer_pending
         """The deterministic merge: the pending summary (always older
         than anything still buffered) first, then the buffered batch
         at the next expected SEQUENCE number — never whichever batch
@@ -388,7 +388,7 @@ class FrontDoor:
                 self._applying = False
                 self._cv.notify_all()
 
-    def _apply(self, popped):
+    def _apply(self, popped):  # deterministic; mutates: summaries_applied, applied_batches, applied_matches, applied_log
         kind, payload = popped
         obs = self._obs()
         if kind == "summary":

@@ -78,11 +78,21 @@ def dump_debug_bundle(obs, path, config=None):
     (tmp / "profile.txt").write_text(
         profiler.collapsed() if profiler is not None else ""
     )
+    # The static-analysis state of the tree at failure time: a
+    # full-registry jaxlint run over the default targets, as SARIF.
+    # A postmortem diff of two bundles then shows whether the tree's
+    # lint surface moved between the runs. Imported lazily — jaxlint
+    # is jax-free stdlib, but this module's import-time contract is
+    # stdlib-only.
+    from arena.analysis import jaxlint
+    (tmp / "lint.sarif").write_text(jaxlint._sarif_report(
+        jaxlint.lint_paths(jaxlint.default_targets(), keep_suppressed=True)
+    ))
     (tmp / "MANIFEST.json").write_text(json.dumps({
         "bundle": "arena-debug",
         "written_at_unix": time.time(),
         "files": ["trace.json", "metrics.json", "config.json",
-                  "events.json", "profile.txt"],
+                  "events.json", "profile.txt", "lint.sarif"],
         "spans_recorded": obs.tracer.recorded,
         "trace_dropped": obs.tracer.dropped,
         "events_recorded": len(events),

@@ -53,7 +53,7 @@ DEFAULT_BASE = 1500.0
 _LN10 = math.log(10.0)
 
 
-def elo_expected(r_winner, r_loser, scale=DEFAULT_SCALE):
+def elo_expected(r_winner, r_loser, scale=DEFAULT_SCALE):  # deterministic
     """P(winner beats loser) under Elo: 1 / (1 + 10^((rl - rw)/scale)).
 
     Written as a sigmoid — 10^x == exp(x·ln10) exactly — because XLA's
@@ -64,7 +64,7 @@ def elo_expected(r_winner, r_loser, scale=DEFAULT_SCALE):
     return jax.nn.sigmoid((r_winner - r_loser) * (_LN10 / scale))
 
 
-def elo_deltas(ratings, winners, losers, valid=None, k=DEFAULT_K, scale=DEFAULT_SCALE):
+def elo_deltas(ratings, winners, losers, valid=None, k=DEFAULT_K, scale=DEFAULT_SCALE):  # deterministic
     """Per-match rating delta earned by each winner (loser gets -delta).
 
     `valid` is an optional 0/1 mask for padded batch slots (shape-
@@ -77,7 +77,7 @@ def elo_deltas(ratings, winners, losers, valid=None, k=DEFAULT_K, scale=DEFAULT_
     return d
 
 
-def elo_batch_update(
+def elo_batch_update(  # deterministic
     ratings, winners, losers, valid=None, k=DEFAULT_K, scale=DEFAULT_SCALE
 ):
     """One batched Elo round via `jax.ops.segment_sum` scatter-add.
@@ -95,7 +95,7 @@ def elo_batch_update(
     )
 
 
-def sorted_segment_sum(values, perm, bounds):
+def sorted_segment_sum(values, perm, bounds):  # deterministic
     """Scatter-free segment sum over a precomputed grouping.
 
     `perm` permutes `values` into segment-sorted order; `bounds[s]` is
@@ -110,7 +110,7 @@ def sorted_segment_sum(values, perm, bounds):
     return cs[bounds[1:]] - cs[bounds[:-1]]
 
 
-def elo_batch_update_sorted(
+def elo_batch_update_sorted(  # deterministic
     ratings, winners, losers, valid, perm, bounds, k=DEFAULT_K, scale=DEFAULT_SCALE
 ):
     """One batched Elo round on the scatter-free hot path.
@@ -125,7 +125,7 @@ def elo_batch_update_sorted(
     return ratings + sorted_segment_sum(signed, perm, bounds)
 
 
-def elo_epoch(
+def elo_epoch(  # deterministic
     ratings, winners, losers, valid, perms, bounds, k=DEFAULT_K, scale=DEFAULT_SCALE
 ):
     """A full pass over pre-bucketed batches, fused into ONE computation.
@@ -164,7 +164,7 @@ def elo_epoch(
 # comparable and finite).
 
 
-def bt_mm_step(strengths, winners, losers, valid, perm, bounds, win_counts, prior):
+def bt_mm_step(strengths, winners, losers, valid, perm, bounds, win_counts, prior):  # deterministic
     """One Bradley–Terry MM update over all matches (vectorized)."""
     s = strengths[winners] + strengths[losers]
     inv = valid / s
@@ -176,7 +176,7 @@ def bt_mm_step(strengths, winners, losers, valid, perm, bounds, win_counts, prio
     return new
 
 
-def bt_fit(
+def bt_fit(  # deterministic
     num_players,
     winners,
     losers,
@@ -205,7 +205,7 @@ def bt_fit(
     return out
 
 
-def sorted_segment_sum_chunked(values, perms, bounds):
+def sorted_segment_sum_chunked(values, perms, bounds):  # deterministic
     """Scatter-free segment sum over a CHUNKED grouping.
 
     The whole-set grouping split into fixed-size chunks over the
@@ -231,7 +231,7 @@ def sorted_segment_sum_chunked(values, perms, bounds):
     return out
 
 
-def bt_mm_step_chunked(strengths, winners, losers, perms, bounds, win_counts, prior):
+def bt_mm_step_chunked(strengths, winners, losers, perms, bounds, win_counts, prior):  # deterministic
     """One Bradley–Terry MM update via the chunked segment sum.
 
     Same update rule as `bt_mm_step`; the denominator accumulates
@@ -249,7 +249,7 @@ def bt_mm_step_chunked(strengths, winners, losers, perms, bounds, win_counts, pr
     return new * jnp.exp(-jnp.mean(jnp.log(new)))
 
 
-def bt_fit_chunked(
+def bt_fit_chunked(  # deterministic
     num_players,
     winners,
     losers,
@@ -283,7 +283,7 @@ def jit_bt_fit_chunked(num_players, num_iters=50, prior=0.1):
     )
 
 
-def bt_log_likelihood(strengths, winners, losers, valid=None):
+def bt_log_likelihood(strengths, winners, losers, valid=None):  # deterministic
     """Total log-likelihood of the observed outcomes (for tests: each
     MM step must not decrease it)."""
     ll = jnp.log(strengths[winners] / (strengths[winners] + strengths[losers]))

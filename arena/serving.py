@@ -103,7 +103,7 @@ def _array_entry(name, arr, offset):
 
 
 def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
-                   store_state, ratings, queue):
+                   store_state, ratings, queue):  # deterministic
     """Write one snapshot directory: arrays.bin + manifest.json.
 
     `store_state` is `MergeableCSR.export_state()` output; `ratings` a
@@ -172,7 +172,7 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
     return manifest
 
 
-def read_snapshot(path):
+def read_snapshot(path):  # deterministic
     """Validate and load one snapshot directory.
 
     Returns `(manifest, arrays)` with every array materialized as an
@@ -701,7 +701,7 @@ class ArenaServer:  # protocol: close
         self._h_staleness.record(out["staleness"], trace_id=qspan.trace_id)
         return out
 
-    def _player_row(self, view, p, rank=None):
+    def _player_row(self, view, p, rank=None):  # pure-render(view)
         row = {
             "player": p,
             "rating": float(view.ratings[p]),
@@ -861,7 +861,7 @@ def _split_queue(arrays):
     )
 
 
-def _elo_win_prob(r_a, r_b, scale):
+def _elo_win_prob(r_a, r_b, scale):  # deterministic
     """Host-side Elo win probability (see `ratings.elo_expected` for
     the device form): 1 / (1 + 10^((r_b - r_a)/scale))."""
     return 1.0 / (1.0 + math.pow(10.0, (r_b - r_a) / scale))

@@ -684,6 +684,51 @@ MUTATIONS = (
         "red — killed by test_release_inside_helper_counts (and "
         "test_full_tree_lints_clean_with_concurrency_rules_active)",
     ),
+    (
+        "fixpoint-stops-at-one-hop",
+        "arena/analysis/effects.py",
+        "    while changed:  # to fixpoint: one call-graph hop per pass",
+        "    if changed:  # one propagation pass only (the v3/v4 shape)",
+        "the effect-summary engine must propagate to FIXPOINT over call "
+        "edges; stopped after one hop, a 2-hop chain (contract fn -> "
+        "helper -> clock) reads clean and the `# deterministic` contract "
+        "silently stops meaning transitive — killed by "
+        "test_nondeterminism_propagates_over_two_call_hops (the corpus "
+        "file IS the 2-hop chain)",
+    ),
+    (
+        "check-then-act-ignores-reacquire",
+        "arena/analysis/effects.py",
+        "            if rebound:\n"
+        "                # Rebinding is the re-check credit: a fresh read under\n"
+        "                # a re-acquired lock replaces the stale fact entirely.\n"
+        "                facts = {f for f in facts if f[0] not in rebound}",
+        "            if rebound:\n"
+        "                pass  # re-check credit deliberately dropped",
+        "the stale-fact KILL on rebind is what makes the SANCTIONED fix "
+        "(re-read the guarded field under the re-acquired lock, act on "
+        "the fresh copy) lint clean; without it the double-checked idiom "
+        "flags forever and the rule can only be silenced, not satisfied "
+        "— killed by test_recheck_under_reacquired_lock_lints_clean",
+    ),
+    (
+        "pure-render-param-reads-flagged-as-hidden",
+        "arena/analysis/effects.py",
+        '            if root == view or (root != "self" and root in params):\n'
+        "                # Reads through the named view or any other parameter\n"
+        "                # ARE the contract's declared inputs — never hidden.\n"
+        "                continue\n"
+        '            if root == "self" and node.attr not in methods:',
+        "            if node.attr not in methods:",
+        "`# pure-render(view)` means 'renders FROM its inputs': reads "
+        "through the named view (and any other parameter) are the "
+        "declared data flow; dropping the exemption AND the self-only "
+        "gate flags them as hidden state, forcing suppressions onto "
+        "every correct render — the real ArenaServer._player_row "
+        "would go red and the clean-tree gate with it — killed by "
+        "test_pure_render_reading_only_its_view_lints_clean (and "
+        "test_full_tree_lints_clean_with_concurrency_rules_active)",
+    ),
 )
 
 

@@ -1,0 +1,251 @@
+"""jaxlint v5: the interprocedural effect-contract analyzer.
+
+Pins the three properties the mutation audit leans on (each test here
+is the NAMED kill for one effects.py mutant) plus the acceptance
+criterion's real-code-shaped fixtures:
+
+- summaries propagate to FIXPOINT over call edges — a 2-hop chain
+  (contract fn -> helper -> clock) is caught, so a one-hop engine
+  (the v3/v4 shape) demonstrably is not enough;
+- the check-then-act detector credits the RE-CHECK idiom — a fresh
+  read under a re-acquired lock kills the stale fact, so the sanctioned
+  fix lints clean;
+- `# pure-render(view)` treats reads through the named view (and any
+  other parameter) as the contract's declared inputs, never hidden
+  state.
+"""
+
+import pathlib
+
+from arena.analysis import jaxlint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CORPUS = REPO / "arena" / "analysis" / "badcorpus"
+
+
+def rules_of(src, name="t.py"):
+    return {f.rule for f in jaxlint.lint_source(src, name)}
+
+
+# --- interprocedural fixpoint (mutant: fixpoint-stops-at-one-hop) ---------
+
+
+def test_nondeterminism_propagates_over_two_call_hops():
+    """The corpus file IS the two-hop chain: `stamped_score` (the
+    contract) calls `_adjusted` calls `_jitter` calls `time.time`. A
+    summary engine that stops after one propagation pass sees
+    `_adjusted` as clean and the contract as satisfied — this test is
+    the named kill for the fixpoint-stops-at-one-hop mutant."""
+    findings = jaxlint.lint_paths(
+        [str(CORPUS / "bad_nondeterministic_contract.py")]
+    )
+    assert {f.rule for f in findings} == {"nondeterminism-in-deterministic-fn"}
+    # ...and the finding names the contract function, not the helper:
+    # the blame lands where the promise was made.
+    assert any("stamped_score" in f.message for f in findings)
+
+
+def test_three_hop_chain_through_methods_is_caught():
+    """Same property, deeper and through `self.` edges: the fixpoint
+    must close over method calls too, not just module functions."""
+    src = (
+        "import time\n"
+        "\n"
+        "\n"
+        "class Scorer:\n"
+        "    def _clock(self):\n"
+        "        return time.time()\n"
+        "\n"
+        "    def _salt(self):\n"
+        "        return self._clock() % 1.0\n"
+        "\n"
+        "    def _shift(self, x):\n"
+        "        return x + self._salt()\n"
+        "\n"
+        "    def score(self, x):  # deterministic\n"
+        "        return self._shift(x)\n"
+    )
+    assert rules_of(src) == {"nondeterminism-in-deterministic-fn"}
+
+
+def test_deterministic_chain_lints_clean():
+    """The same call shape with no nondet source anywhere stays green:
+    the rule fires on the CLOSURE's contents, not on call depth."""
+    src = (
+        "def _base(x):\n"
+        "    return x * 2.0\n"
+        "\n"
+        "\n"
+        "def _mid(x):\n"
+        "    return _base(x) + 1.0\n"
+        "\n"
+        "\n"
+        "def total(x):  # deterministic\n"
+        "    return _mid(x)\n"
+    )
+    assert rules_of(src) == set()
+
+
+# --- pure-render (mutant: pure-render-param-reads-flagged-as-hidden) ------
+
+
+def test_pure_render_reading_only_its_view_lints_clean():
+    """Reads through the named view AND other parameters are the
+    contract's declared inputs — the named kill for the
+    pure-render-param-reads-flagged-as-hidden mutant."""
+    src = (
+        "class Server:\n"
+        "    def row(self, view, p, rank=None):  # pure-render(view)\n"
+        "        r = view.ratings[p]\n"
+        "        return {'player': p, 'rating': r, 'rank': rank}\n"
+    )
+    assert rules_of(src) == set()
+
+
+def test_pure_render_hidden_self_read_fires():
+    src = (
+        "class Server:\n"
+        "    def __init__(self):\n"
+        "        self._style = 'wide'\n"
+        "\n"
+        "    def row(self, view, p):  # pure-render(view)\n"
+        "        return (self._style, view.ratings[p])\n"
+    )
+    assert rules_of(src) == {"hidden-state-read-in-pure-render"}
+
+
+# --- check-then-act (mutant: check-then-act-ignores-reacquire) ------------
+
+RECHECK_SRC = (
+    "import threading\n"
+    "\n"
+    "\n"
+    "class Booker:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._seats = 8  # guarded_by: _lock\n"
+    "\n"
+    "    def book(self):\n"
+    "        with self._lock:\n"
+    "            seats = self._seats\n"
+    "        if seats == 0:\n"
+    "            return False\n"
+    "        with self._lock:\n"
+    "            seats = self._seats\n"
+    "            if seats > 0:\n"
+    "                self._seats = seats - 1\n"
+    "                return True\n"
+    "        return False\n"
+)
+
+
+def test_recheck_under_reacquired_lock_lints_clean():
+    """The sanctioned fix for the corpus race — double-checked style:
+    the stale copy only gates an early REFUSAL (no state act rides on
+    it), and the act path re-reads the field under the re-acquired
+    lock and decides on the FRESH copy. The rebind kills the stale
+    fact — the named kill for the check-then-act-ignores-reacquire
+    mutant."""
+    assert rules_of(RECHECK_SRC) == set()
+    # ...and dropping the re-read (acting on the escaped copy)
+    # resurrects the race, so the clean verdict above is the re-check
+    # credit, not blindness.
+    broken = RECHECK_SRC.replace(
+        "        with self._lock:\n"
+        "            seats = self._seats\n"
+        "            if seats > 0:\n",
+        "        with self._lock:\n"
+        "            if seats > 0:\n",
+    )
+    assert broken != RECHECK_SRC
+    assert rules_of(broken) == {"check-then-act-race"}
+
+
+def test_single_critical_section_lints_clean():
+    """Check and act inside ONE lock-held region is the other
+    sanctioned shape — no finding."""
+    src = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Booker:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._seats = 8  # guarded_by: _lock\n"
+        "\n"
+        "    def book(self):\n"
+        "        with self._lock:\n"
+        "            if self._seats > 0:\n"
+        "                self._seats -= 1\n"
+        "                return True\n"
+        "        return False\n"
+    )
+    assert rules_of(src) == set()
+
+
+def test_check_then_act_fires_on_frontdoor_shaped_pipeline():
+    """The acceptance criterion's real-code-shaped fixture: a FrontDoor
+    -like stage with condition-variable-guarded pending state. The
+    check (is a slot free?) reads under the cv, the act (claim the
+    slot) happens in a LATER critical section against the stale copy —
+    two producers that both saw `pending < limit` both enqueue past
+    the limit. Rule fires; the rest of the registry stays quiet."""
+    src = (
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Stage:\n"
+        "    def __init__(self, limit):\n"
+        "        self._cv = threading.Condition()\n"
+        "        self._limit = limit\n"
+        "        self._pending = 0  # guarded_by: _cv\n"
+        "        self._buffer = []  # guarded_by: _cv\n"
+        "\n"
+        "    def submit(self, batch):\n"
+        "        with self._cv:\n"
+        "            pending = self._pending\n"
+        "        if pending < self._limit:\n"
+        "            with self._cv:\n"
+        "                self._pending = pending + 1\n"
+        "                self._buffer.append(batch)\n"
+        "                self._cv.notify()\n"
+        "            return True\n"
+        "        return False\n"
+    )
+    assert rules_of(src) == {"check-then-act-race"}
+
+
+def test_corpus_race_fixture_fires_only_its_rule():
+    """Every access in the corpus file is individually lock-held, so
+    the v2 unguarded-shared-write rule has nothing to say — the
+    BETWEEN-sections race is exactly the new rule's territory."""
+    findings = jaxlint.lint_paths([str(CORPUS / "bad_check_then_act.py")])
+    assert {f.rule for f in findings} == {"check-then-act-race"}
+
+
+# --- undeclared mutation --------------------------------------------------
+
+
+def test_mutates_allowance_covers_transitive_writes():
+    """`# mutates:` is checked against the interprocedural CLOSURE:
+    a helper's write counts against the caller's allowance, and
+    declaring it makes the contract green."""
+    src = (
+        "class Rounds:\n"
+        "    def __init__(self):\n"
+        "        self.ratings = {}\n"
+        "        self.rounds_applied = 0\n"
+        "\n"
+        "    def _bump(self):\n"
+        "        self.rounds_applied += 1\n"
+        "\n"
+        "    def apply_round(self, deltas):"
+        "  # deterministic; mutates: ratings, rounds_applied\n"
+        "        for player in deltas:\n"
+        "            self.ratings[player] = 1.0\n"
+        "        self._bump()\n"
+    )
+    assert rules_of(src) == set()
+    undeclared = src.replace("mutates: ratings, rounds_applied",
+                             "mutates: ratings")
+    assert rules_of(undeclared) == {"undeclared-mutation-in-contract"}

@@ -52,6 +52,11 @@ CORPUS_EXPECTED = {
     "bad_use_after_close.py": {"use-after-close"},
     "bad_lock_held_raise.py": {"lock-held-across-raise"},
     "bad_missing_finally.py": {"missing-finally-for-paired-call"},
+    # jaxlint v5: the interprocedural effect-contract analyzer.
+    "bad_nondeterministic_contract.py": {"nondeterminism-in-deterministic-fn"},
+    "bad_impure_render.py": {"hidden-state-read-in-pure-render"},
+    "bad_check_then_act.py": {"check-then-act-race"},
+    "bad_undeclared_mutation.py": {"undeclared-mutation-in-contract"},
 }
 
 # The --format=json per-finding schema (the mechanical consumption
@@ -659,3 +664,82 @@ def test_baseline_malformed_file_is_rc2(tmp_path, capsys):
         [f"--baseline={baseline}", str(CORPUS / "bad_timing.py")]
     )
     assert rc == 2
+
+
+# --- v5 CLI satellites: baseline x --rules composition + --jobs -----------
+
+
+def test_baseline_records_its_rule_coverage(tmp_path, capsys):
+    """Regression (v5 satellite): a baseline written under --rules=<X>
+    only ever SAW rule X — it must not act as an allowlist for rules
+    it never ran. The file records its coverage; a later full-registry
+    run reports the other rules' findings as NEW (rc 1)."""
+    baseline = tmp_path / "baseline.json"
+    # bad_timing.py trips timing-without-block; write a baseline that
+    # covers only mutable-closure (which the file does NOT trip).
+    target = str(CORPUS / "bad_timing.py")
+    rc = jaxlint.main(
+        ["--rules=mutable-closure", f"--baseline={baseline}", target]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    data = json.loads(baseline.read_text())
+    assert data["rules"] == ["mutable-closure"]  # coverage recorded
+    # Full-registry run against that narrow baseline: the timing
+    # finding is OUTSIDE the baseline's coverage, so it is new — rc 1.
+    rc = jaxlint.main([f"--baseline={baseline}", target])
+    assert rc == 1
+    assert "timing-without-block" in capsys.readouterr().out
+
+
+def test_full_baseline_composes_with_rules_subset(tmp_path, capsys):
+    """The other half of the composition: a baseline written under the
+    FULL registry (coverage "all") still suppresses its findings when
+    replayed under a --rules subset."""
+    baseline = tmp_path / "baseline.json"
+    target = str(CORPUS / "bad_timing.py")
+    assert jaxlint.main([f"--baseline={baseline}", target]) == 0
+    capsys.readouterr()
+    assert json.loads(baseline.read_text())["rules"] == "all"
+    rc = jaxlint.main(
+        ["--rules=timing-without-block", f"--baseline={baseline}", target]
+    )
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_legacy_baseline_without_coverage_key_still_filters(tmp_path, capsys):
+    """A pre-v5 baseline file (no "rules" key) means full coverage —
+    existing operator baselines keep suppressing, not resurrecting."""
+    baseline = tmp_path / "baseline.json"
+    target = str(CORPUS / "bad_timing.py")
+    assert jaxlint.main([f"--baseline={baseline}", target]) == 0
+    capsys.readouterr()
+    data = json.loads(baseline.read_text())
+    del data["rules"]
+    baseline.write_text(json.dumps(data))
+    rc = jaxlint.main([f"--baseline={baseline}", target])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_parallel_lint_is_bit_identical_to_serial():
+    """--jobs=N is a wall-clock knob ONLY: the findings list (order,
+    lines, messages, suppression flags) is byte-for-byte the serial
+    result, over both the corpus and the clean tree."""
+    serial = jaxlint.lint_paths([str(CORPUS)], keep_suppressed=True)
+    parallel = jaxlint.lint_paths([str(CORPUS)], keep_suppressed=True, jobs=4)
+    assert [f.__dict__ for f in serial] == [f.__dict__ for f in parallel]
+    assert serial  # non-vacuous: the corpus does produce findings
+    assert jaxlint.lint_paths(CLEAN_TARGETS, jobs=4) == []
+
+
+def test_jobs_flag_cli_contract(capsys):
+    """--jobs through the real arg parser: rc semantics unchanged at
+    any N, and a non-positive N is a usage error (rc 2)."""
+    rc = jaxlint.main(["--jobs=4", str(CORPUS / "bad_use_after_donate.py")])
+    assert rc == 1
+    assert "use-after-donate" in capsys.readouterr().out
+    assert jaxlint.main(["--jobs=4"] + CLEAN_TARGETS) == 0
+    assert jaxlint.main(["--jobs=0", str(CORPUS)]) == 2
+    assert "jobs" in capsys.readouterr().err

@@ -44,18 +44,31 @@ LIFECYCLE_RULES = {
     "missing-finally-for-paired-call",
 }
 
+# jaxlint v5: the interprocedural effect-contract analyzer.
+EFFECTS_RULES = {
+    "nondeterminism-in-deterministic-fn",
+    "hidden-state-read-in-pure-render",
+    "check-then-act-race",
+    "undeclared-mutation-in-contract",
+}
+
 
 def test_full_tree_lints_clean_with_concurrency_rules_active():
     """The acceptance criterion: `python -m arena.analysis` over the
     clean tree reports 0 findings WITH the four concurrency rules, the
-    three v3 abstract-interpretation families, AND the four v4
-    lifecycle rules registered — the real guarded_by annotations, the
-    real bucketing/validator call sites, and the real `# protocol:`
-    contracts all in place."""
+    three v3 abstract-interpretation families, the four v4 lifecycle
+    rules, AND the four v5 effect-contract rules registered — the real
+    guarded_by annotations, the real bucketing/validator call sites,
+    the real `# protocol:` contracts, and the real `# deterministic` /
+    `# pure-render` contracts all in place. Runs with jobs=2: the
+    22-rule pass stays fast, and the parallel path is exercised on
+    every suite run (bit-identity to serial is pinned in
+    test_analysis_lint.py)."""
     assert CONCURRENCY_RULES <= set(jaxlint.RULES)
     assert ABSINT_RULES <= set(jaxlint.RULES)
     assert LIFECYCLE_RULES <= set(jaxlint.RULES)
-    findings = jaxlint.lint_paths(jaxlint.default_targets())
+    assert EFFECTS_RULES <= set(jaxlint.RULES)
+    findings = jaxlint.lint_paths(jaxlint.default_targets(), jobs=2)
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
 
@@ -105,6 +118,44 @@ def test_clean_pass_is_not_vacuous():
         assert cls.protocol_terminal >= terminal, (
             f"{rel}: {cls_name} terminal methods drifted"
         )
+    # ...and (v5) the effect pass demonstrably sees the real
+    # `# deterministic` / `# pure-render` contracts on the apply and
+    # render paths — the annotations ROADMAP items 1 and 2 lean on.
+    contracts = {
+        "arena/engine.py": {
+            "ArenaEngine.update": "deterministic",
+            "ArenaEngine.ingest": "deterministic",
+        },
+        "arena/net/frontdoor.py": {
+            "FrontDoor._apply": "deterministic",
+            "FrontDoor._pop_next_locked": "deterministic",
+        },
+        "arena/ratings.py": {
+            "elo_batch_update_sorted": "deterministic",
+            "elo_epoch": "deterministic",
+            "bt_fit": "deterministic",
+        },
+        "arena/serving.py": {
+            "write_snapshot": "deterministic",
+            "ArenaServer._player_row": "pure_render",
+        },
+    }
+    for rel, expected in contracts.items():
+        path = REPO / rel
+        ctx = jaxlint.ModuleContext(str(path), path.read_text())
+        for qualname, kind in expected.items():
+            contract = ctx.symbols.contracts.get(qualname)
+            assert contract is not None, (
+                f"{rel}: {qualname} lost its effect contract"
+            )
+            if kind == "deterministic":
+                assert contract["deterministic"], (
+                    f"{rel}: {qualname} no longer `# deterministic`"
+                )
+            else:
+                assert contract["pure_render"] == "view", (
+                    f"{rel}: {qualname} no longer `# pure-render(view)`"
+                )
 
 
 def test_every_registered_rule_fires_on_the_corpus():

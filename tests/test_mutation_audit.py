@@ -74,7 +74,9 @@ def test_mutations_cover_every_policed_surface():
     burn-rate threshold direction, the /debug wire envelope), and since
     PR 14 the jaxlint v4 lifecycle analyzer (the CFG's exception edge,
     the terminal-state transition, the one-hop helper-release
-    credit)."""
+    credit), and since PR 15 the jaxlint v5 effect-contract analyzer
+    (the call-graph fixpoint, the check-then-act re-check credit, the
+    pure-render parameter exemption)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -84,6 +86,7 @@ def test_mutations_cover_every_policed_surface():
         "arena/analysis/absint.py",
         "arena/analysis/cfg.py",
         "arena/analysis/lifecycle.py",
+        "arena/analysis/effects.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",
@@ -125,6 +128,7 @@ def _fake_sources_only(dest):
         "arena/analysis/absint.py",
         "arena/analysis/cfg.py",
         "arena/analysis/lifecycle.py",
+        "arena/analysis/effects.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",

@@ -394,9 +394,15 @@ def test_debug_bundle_contains_registry_dump(tmp_path):
     manifest = json.loads((path / "MANIFEST.json").read_text())
     assert set(manifest["files"]) == {
         "trace.json", "metrics.json", "config.json", "events.json",
-        "profile.txt",
+        "profile.txt", "lint.sarif",
     }
     assert manifest["spans_recorded"] == 1
+    # The v5 addition: the tree's lint surface at failure time, as one
+    # SARIF document (suppressed findings included, so the bundle shows
+    # the suppressions too, not just the clean verdict).
+    sarif = json.loads((path / "lint.sarif").read_text())
+    assert sarif["version"] == "2.1.0"
+    assert sarif["runs"][0]["tool"]["driver"]["name"] == "jaxlint"
     metrics = json.loads((path / "metrics.json").read_text())
     assert metrics["counters"]['arena_test_total{policy="block"}'] == 5
     assert metrics["histograms"]["arena_test_seconds"]["count"] == 1
