@@ -1302,7 +1302,14 @@ def run_frontend_benchmark():
     zero dangling orphans at quiescence. The equivalence HARD gate
     then replays the front door's full applied log (both phases,
     summary updates included) through a sync single-producer engine in
-    sequence order and requires bit-exact ratings."""
+    sequence order and requires bit-exact ratings.
+
+    PR 16 (the fast wire path): readers mix singles with `POST /query`
+    batches (each batched lookup counts as one wire query — same unit
+    as a GET), every batch response must answer ALL its parts from ONE
+    view generation, and a NEW cache-consistency HARD gate re-renders
+    every current-generation cache entry from scratch and requires the
+    cached bytes to match byte-for-byte."""
     base_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
     stream_batch = _env_int("ARENA_BENCH_DELTA", 10_000)
     num_players = _env_int("ARENA_BENCH_PLAYERS", 1_000)
@@ -1368,7 +1375,7 @@ def run_frontend_benchmark():
     base_mass = num_players * float(ratings.DEFAULT_BASE)
     stop_event = threading.Event()
     torn = []
-    counts = {"queries": 0}
+    counts = {"queries": 0, "requests": 0}
     counts_lock = threading.Lock()
     max_mass_dev = [0.0]
 
@@ -1377,6 +1384,17 @@ def run_frontend_benchmark():
         last_watermark = 0
         pid = (rid * 7) % num_players
         mine = 0
+        sent = 0
+        # One dashboard-shaped batch: a page plus ten player rows and
+        # ten h2h cells, each spec the payload of one single GET — 21
+        # lookups amortized over ONE round-trip.
+        batch_specs = [{"leaderboard": [0, 10]}]
+        for k in range(10):
+            batch_specs.append({"players": [(pid + k) % num_players]})
+            batch_specs.append(
+                {"pairs": [[(pid + k) % num_players,
+                            (pid + k + 1) % num_players]]}
+            )
         try:
             while not stop_event.is_set():
                 for path in (
@@ -1389,6 +1407,7 @@ def run_frontend_benchmark():
                         torn.append(f"reader {rid}: {path} -> {status}")
                         return
                     mine += 1
+                    sent += 1
                     if resp["watermark"] < last_watermark:
                         torn.append(f"reader {rid}: watermark went backwards")
                         return
@@ -1400,9 +1419,29 @@ def run_frontend_benchmark():
                             return
                         dev = abs(resp["view_ratings_sum"] - base_mass) / num_players
                         max_mass_dev[0] = max(max_mass_dev[0], dev)
+                # The batched read path (PR 16): 21 lookups, ONE HTTP
+                # round-trip, ONE view — each part counts as one wire
+                # query (the same unit as a single GET above).
+                status, resp = client.batch_query(batch_specs)
+                sent += 1
+                if status != 200:
+                    torn.append(f"reader {rid}: /query -> {status}")
+                    return
+                if resp["watermark"] < last_watermark:
+                    torn.append(f"reader {rid}: watermark went backwards")
+                    return
+                last_watermark = resp["watermark"]
+                seqs = {part["view_seq"] for part in resp["results"]}
+                if seqs != {resp["view_seq"]}:
+                    torn.append(
+                        f"reader {rid}: batch split across views {seqs}"
+                    )
+                    return
+                mine += len(resp["results"])
         finally:
             with counts_lock:
                 counts["queries"] += mine
+                counts["requests"] += sent
             client.close()
 
     def producer(pid, slices):
@@ -1593,6 +1632,39 @@ def run_frontend_benchmark():
     finally:
         debug_client.close()
 
+    # --- cache-consistency HARD gate (PR 16) --------------------------
+    # The overload's final flush advanced the engine, so one fresh GET
+    # first: it refreshes the view (staleness-bounded) and fills the
+    # current cache generation (the prerender listener already re-
+    # rendered the hot pages at refresh time). Then every entry of the
+    # CURRENT generation is re-rendered from scratch and must match
+    # the cached bytes byte-for-byte — cached bytes that differ from a
+    # fresh render at the same watermark are a correctness bug, not a
+    # perf detail.
+    gate_client = net.WireClient(wire.host, wire.port)
+    try:
+        status, _resp = gate_client.get("/leaderboard?offset=0&limit=10")
+        if status != 200:
+            raise FrontendGateError(
+                f"cache-gate populate GET -> {status}; cannot verify "
+                "cache consistency without a live read"
+            )
+    finally:
+        gate_client.close()
+    cache_checked, cache_mismatches = wire.verify_cache_consistency()
+    if cache_mismatches:
+        raise FrontendGateError(
+            f"{len(cache_mismatches)} cached response(s) differ from a "
+            f"fresh render at the same watermark: {cache_mismatches[:4]}; "
+            "the byte cache must be invisible to clients"
+        )
+    if cache_checked < 1:
+        raise FrontendGateError(
+            "the cache-consistency gate checked zero entries; the byte "
+            "cache never held a current-generation response, so the "
+            "fast path was never exercised"
+        )
+
     # --- the equivalence HARD gate: sync replay of the applied log ---
     # (both phases, summary updates included) in sequence order.
     eng_sync = engine.ArenaEngine(num_players)
@@ -1633,6 +1705,9 @@ def run_frontend_benchmark():
     window_rotations = obs_live.windows.health()["rotations"]
     profiler_samples = obs_live.profiler.samples
     slo_fired_total = slo_engine.alerts_fired()
+    cache_stats = dict(stats["net"]["cache"])
+    cache_reads = cache_stats["hits"] + cache_stats["misses"]
+    front_end = wire.front_end
     wire.close()
     frontdoor.close()
     srv.close()
@@ -1658,8 +1733,19 @@ def run_frontend_benchmark():
         },
         "frontend": {
             "elapsed_s": round(elapsed, 6),
+            "front_end": front_end,
             "wire_queries": counts["queries"],
+            "wire_requests": counts["requests"],
             "wire_queries_per_s": round(qps, 2),
+            "cache": {
+                **cache_stats,
+                "hit_rate": (
+                    round(cache_stats["hits"] / cache_reads, 4)
+                    if cache_reads else None
+                ),
+                "consistency_checked": cache_checked,
+                "consistency_mismatches": 0,  # gate raised otherwise
+            },
             "request_latency_ms": {
                 "p50": round(p50 * 1e3, 3) if p50 is not None else None,
                 "p99": round(p99 * 1e3, 3) if p99 is not None else None,

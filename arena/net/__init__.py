@@ -12,9 +12,14 @@ Three parts, layered over the existing serving and pipeline stack:
   writers), and bounded-degradation load shedding (oldest batches
   coalesce into a summary update; the summary's backlog is staleness-
   bounded, trimming beyond it is counted, never silent).
-- `arena.net.server`    — the HTTP/JSON server (`ThreadingHTTPServer`,
-  stdlib only): /leaderboard, /player/{id}, /h2h, /submit, /stats
-  (Prometheus render()), /healthz.
+- `arena.net.server`    — the HTTP/JSON server (stdlib only):
+  /leaderboard, /player/{id}, /h2h, /query, /submit, /stats
+  (Prometheus render()), /healthz, /debug/*.
+- `arena.net.fastpath`  — the fast read path (PR 16): the
+  watermark-keyed response byte cache, head-splice rendering (cached
+  bytes completed with each request's own trace id), and the
+  `selectors` event-loop front end that answers reads inline while
+  /submit keeps its blocking worker pool.
 
 What this tier deliberately defers (ROADMAP item 2): replica catch-up
 — a read-only `ArenaHTTPServer(frontdoor=None)` already serves 503 on
@@ -31,29 +36,43 @@ from arena.net.frontdoor import (
     FrontDoor,
     FrontDoorError,
 )
+from arena.net.fastpath import (
+    DEFAULT_CACHE_CAPACITY,
+    DEFAULT_PRERENDER_PAGES,
+    EventLoopFrontEnd,
+    ResponseCache,
+)
 from arena.net.protocol import (
     ENDPOINTS,
+    MAX_BATCH_QUERIES,
     ProtocolError,
     WireClient,
     make_response,
     parse_path,
+    parse_query_body,
     parse_submit_body,
 )
 from arena.net.server import ArenaHTTPServer
 
 __all__ = [
     "ArenaHTTPServer",
+    "DEFAULT_CACHE_CAPACITY",
     "DEFAULT_CAPACITY",
     "DEFAULT_MAX_STALENESS_MATCHES",
+    "DEFAULT_PRERENDER_PAGES",
     "ENDPOINTS",
+    "EventLoopFrontEnd",
     "FrontDoor",
     "FrontDoorError",
+    "MAX_BATCH_QUERIES",
     "POLICY_COALESCE",
     "POLICY_STALENESS",
     "ProtocolError",
+    "ResponseCache",
     "SUMMARY_PRODUCER",
     "WireClient",
     "make_response",
     "parse_path",
+    "parse_query_body",
     "parse_submit_body",
 ]

@@ -1,0 +1,645 @@
+"""The fast wire read path: byte cache + event-loop front end (PR 16).
+
+The baseline wire tier pinned ~40 queries/s against ~8,000 in-process —
+a 200x transport tax paid to thread-per-connection dispatch and
+per-request JSON rendering. This module removes both:
+
+- `ResponseCache` — a **watermark-keyed byte cache**. A leaderboard
+  page / player row / h2h response is rendered once per (endpoint,
+  params, view generation) and served as bytes until the serving view
+  changes. The key carries the view's `seq`, which advances whenever
+  the view watermark advances (and on every other refresh — intervals
+  and win/loss counts can change without the watermark moving), so a
+  cached response can never outlive the view that rendered it. The
+  render itself is safe to cache because `ArenaServer._player_row` is
+  contract-`# pure-render(view)` under jaxlint: a hidden-state read
+  that would poison the cache is a lint error before it ships.
+
+- **Head-splice rendering** (`render_head` / `complete_response`).
+  Every JSON response carries a per-request ``trace_id`` next to the
+  watermark, which would defeat byte caching — so the cache stores the
+  response *head* (the full envelope minus the trailing trace_id pair
+  and closing brace) and each request completes it with its own trace
+  id in one bytes-concat. `make_response` appends the authoritative
+  watermark/trace_id pair LAST, so the splice is byte-identical to a
+  fresh `json.dumps(make_response(...))` — the property the bench's
+  cache-consistency hard gate (`verify_cache_consistency`) re-checks
+  against live traffic.
+
+- `EventLoopFrontEnd` — a `selectors`-based (epoll on Linux, stdlib
+  only) single-thread event loop for the read path. GET endpoints and
+  POST /query are answered inline on the loop (they only read
+  immutable views and the cache); POST /submit is handed to a small
+  blocking worker pool, because `FrontDoor.submit` may block on
+  admission backpressure and the loop must never block. Per-connection
+  responses stay in request order: while a submit is in flight the
+  connection's parser is paused, and the pool's completion re-enters
+  the loop through a socketpair wakeup.
+
+Stale serves bypass the cache entirely (restore in progress, or a
+pipeline deeper than the staleness bound): the ``stale`` flag and live
+staleness number pass through unmodified, exactly as the slow path
+reports them.
+"""
+
+import json
+import http.client
+import selectors
+import socket
+import threading
+import time
+
+from arena.net import protocol
+
+DEFAULT_CACHE_CAPACITY = 256
+# Hot leaderboard pages rebuilt eagerly at view-refresh time: the top
+# of the board (what everyone polls) and the default page size.
+DEFAULT_PRERENDER_PAGES = ((0, 10), (0, protocol.DEFAULT_PAGE_LIMIT))
+DEFAULT_SUBMIT_WORKERS = 4
+
+LOOP_THREAD_NAME = "arena-wire-eventloop"
+SUBMIT_WORKER_PREFIX = "arena-wire-submit-"
+
+# HTTP framing bounds: a request that exceeds them is answered once
+# (431/413) and the connection closed — never an unbounded buffer.
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 16 * 1024 * 1024
+RECV_BYTES = 256 * 1024
+LISTEN_BACKLOG = 128
+SELECT_TIMEOUT_S = 0.05
+
+_ACCEPT = "accept"  # selector data tags for the two non-connection fds
+_WAKE = "wake"
+
+CACHEABLE_ENDPOINTS = ("leaderboard", "player", "h2h")
+
+
+# --- byte rendering ---------------------------------------------------------
+
+
+def cache_key(endpoint, params):
+    """The cache key: endpoint + canonicalized parse_path params."""
+    return (endpoint, tuple(sorted(params.items())))
+
+
+def render_head(payload, watermark):
+    """Render a response payload into a cacheable byte head: the full
+    JSON envelope minus the trailing ``"trace_id"`` pair and the
+    closing brace. `make_response` strips any payload-supplied
+    watermark/trace pair and appends the authoritative pair LAST (in
+    insertion order, which `json.dumps` preserves), so
+    `complete_response(head, tid)` is byte-identical to dumping
+    `make_response(payload, watermark=..., trace_id=tid)` fresh."""
+    envelope = protocol.make_response(payload, watermark=watermark, trace_id=0)
+    del envelope["trace_id"]
+    text = json.dumps(envelope)
+    return text[:-1].encode("utf-8")
+
+
+def complete_response(head, trace_id):
+    """Splice THIS request's trace id onto a cached head."""
+    return head + b', "trace_id": ' + str(trace_id).encode("ascii") + b"}"
+
+
+def render_query_payload(srv, view, stale, endpoint, params, staleness=None):
+    """Map one cacheable GET endpoint's parsed params onto a
+    `_query_parts` render against an already-chosen view. `staleness`
+    defaults to the view-stable distance (ingested-at-clone minus
+    watermark) so the rendered bytes are a pure function of
+    (view, params); stale serves pass the live distance instead —
+    honesty outranks cacheability there, and they are never cached."""
+    if staleness is None:
+        staleness = view.matches_ingested - view.watermark
+    if endpoint == "leaderboard":
+        return srv._query_parts(
+            view, stale, (params["offset"], params["limit"]), None, None,
+            0, staleness=staleness,
+        )
+    if endpoint == "player":
+        return srv._query_parts(
+            view, stale, None, [params["player"]], None, 0,
+            staleness=staleness,
+        )
+    if endpoint == "h2h":
+        return srv._query_parts(
+            view, stale, None, None, [(params["a"], params["b"])], 0,
+            staleness=staleness,
+        )
+    raise ValueError(f"endpoint {endpoint!r} is not cacheable")
+
+
+def serve_cached(wire, endpoint, params):
+    """The GET fast path: serve leaderboard/player/h2h bytes from the
+    watermark-keyed cache when the current view still matches; render
+    and fill otherwise. Returns (status, head, view_watermark) — the
+    head is completed with the request's own trace id at write time.
+    Stale serves bypass the cache in BOTH directions (no hit, no
+    fill): the stale flag and live staleness pass through unmodified,
+    and a stale render can never be served to a fresh reader."""
+    srv = wire.server
+    view, stale = srv._serve_view()
+    key = cache_key(endpoint, params)
+    if not stale:
+        head = wire.cache.get(key, view.seq)
+        if head is not None:
+            return 200, head, view.watermark
+    # Miss: render under a serve.query span (same trace story as the
+    # slow path — the net.<endpoint> root span is already open).
+    with srv.obs.span("serve.query"):
+        live = srv._staleness(view) if stale else None
+        payload = render_query_payload(
+            srv, view, stale, endpoint, params, staleness=live
+        )
+    srv._c_queries.inc()
+    head = render_head(payload, view.watermark)
+    if not stale:
+        wire.cache.put(key, view.seq, head)
+    return 200, head, view.watermark
+
+
+def verify_cache_consistency(wire):
+    """The cache-consistency hard gate: re-render every entry of the
+    CURRENT view generation from scratch and compare bytes. Returns
+    (checked, mismatches) — a non-empty mismatch list means the cache
+    would have served bytes that differ from a fresh render at the
+    same watermark, which no deploy gets to ignore (the frontend bench
+    raises on it)."""
+    srv = wire.server
+    view, stale = srv._serve_view()
+    if stale:
+        return 0, []
+    checked = 0
+    mismatches = []
+    for key, (seq, head) in wire.cache.entries():
+        if seq != view.seq:
+            continue
+        endpoint, param_items = key
+        payload = render_query_payload(
+            srv, view, False, endpoint, dict(param_items)
+        )
+        checked += 1
+        if render_head(payload, view.watermark) != head:
+            mismatches.append(key)
+    return checked, mismatches
+
+
+# --- the watermark-keyed byte cache -----------------------------------------
+
+
+class ResponseCache:  # protocol: close
+    """Watermark-keyed response byte cache for the wire read path.
+
+    Maps (endpoint, params) -> (view_seq, head bytes). A `get` hits
+    only when the stored generation equals the CURRENT view's seq —
+    the seq advances whenever the view watermark advances (and on any
+    other refresh), so invalidation is structural, not time-based:
+    cached bytes can never outlive their view. Capacity-bounded;
+    eviction drops dead-generation entries first and counts every
+    removal. All methods are thread-safe (the event loop, the submit
+    pool, and the prerender listener all touch it)."""
+
+    def __init__(self, obs, capacity=DEFAULT_CACHE_CAPACITY,
+                 clock=time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries = {}  # guarded_by: _lock  (key -> (view_seq, head))
+        self._gen = -1  # guarded_by: _lock  (newest view seq cached)
+        self._born = clock()  # guarded_by: _lock  (generation birth time)
+        self._closed = False  # guarded_by: _lock
+        self._c_hits = obs.counter("arena_wire_cache_hits_total")
+        self._c_misses = obs.counter("arena_wire_cache_misses_total")
+        self._c_evictions = obs.counter("arena_wire_cache_evictions_total")
+        self._c_prerenders = obs.counter("arena_wire_cache_prerenders_total")
+        self._g_age = obs.gauge("arena_wire_cache_age_seconds")
+
+    def get(self, key, view_seq):
+        """The cached head for `key` IF it was rendered from the view
+        generation `view_seq`, else None (counted as a miss)."""
+        with self._lock:
+            self._g_age.set(self._clock() - self._born)
+            entry = self._entries.get(key)
+            if entry is not None and entry[0] == view_seq:
+                self._c_hits.inc()
+                return entry[1]
+            self._c_misses.inc()
+            return None
+
+    def put(self, key, view_seq, head, prerendered=False):
+        """Store a rendered head for one view generation. Stale puts
+        (an older generation than the newest cached) are dropped — a
+        slow render must never clobber a fresher entry."""
+        with self._lock:
+            if self._closed or view_seq < self._gen:
+                return
+            if view_seq > self._gen:
+                self._gen = view_seq
+                self._born = self._clock()
+            self._g_age.set(self._clock() - self._born)
+            if key not in self._entries and len(self._entries) >= self.capacity:
+                self._evict_locked()
+            self._entries[key] = (view_seq, head)
+            if prerendered:
+                self._c_prerenders.inc()
+
+    def _evict_locked(self):
+        """Make room: drop every dead-generation entry if any exist,
+        else the oldest-inserted live one. Caller holds `_lock`."""
+        dead = [
+            k for k, (seq, _head) in self._entries.items() if seq < self._gen
+        ]
+        victims = dead if dead else [next(iter(self._entries))]
+        for k in victims:
+            del self._entries[k]
+        self._c_evictions.inc(len(victims))
+
+    def entries(self):
+        """A consistent snapshot of (key, (view_seq, head)) items —
+        what the consistency gate walks."""
+        with self._lock:
+            return list(self._entries.items())
+
+    def size(self):
+        with self._lock:
+            return len(self._entries)
+
+    def close(self):
+        """Terminal: drop every entry and refuse further fills (gets
+        keep answering None — readers drain through the render path)."""
+        with self._lock:
+            self._entries.clear()
+            self._closed = True
+
+
+# --- the event-loop front end -----------------------------------------------
+
+
+class _FrameError(Exception):
+    """Malformed HTTP framing: answered once, then the connection
+    closes (the framing statuses: 400/413/431/501/505)."""
+
+    def __init__(self, status, message):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class _Conn:
+    """Per-connection state, owned by the loop thread. The submit pool
+    sees a `_Conn` only as an opaque token inside a job tuple — every
+    field mutation happens on the loop thread."""
+
+    __slots__ = ("sock", "inbuf", "outbuf", "events", "busy", "close_after",
+                 "closed")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.inbuf = bytearray()
+        self.outbuf = bytearray()
+        self.events = selectors.EVENT_READ
+        self.busy = False  # a /submit response is pending in the pool
+        self.close_after = False
+        self.closed = False
+
+
+def _parse_request(conn):
+    """Parse one complete HTTP/1.x request off the connection's input
+    buffer, consuming it. Returns (method, target, body, keep_alive),
+    or None when the buffer doesn't hold a full request yet. Raises
+    `_FrameError` on malformed framing. Content-Length bodies only —
+    `WireClient` (and `http.client` generally) never sends chunked."""
+    buf = conn.inbuf
+    head_end = buf.find(b"\r\n\r\n")
+    if head_end < 0:
+        if len(buf) > MAX_HEADER_BYTES:
+            raise _FrameError(431, "request headers too large")
+        return None
+    lines = bytes(buf[:head_end]).decode("latin-1").split("\r\n")
+    parts = lines[0].split()
+    if len(parts) != 3:
+        raise _FrameError(400, f"malformed request line: {lines[0]!r}")
+    method, target, version = parts
+    if version not in ("HTTP/1.1", "HTTP/1.0"):
+        raise _FrameError(505, f"unsupported HTTP version: {version!r}")
+    headers = {}
+    for line in lines[1:]:
+        name, sep, value = line.partition(":")
+        if not sep:
+            raise _FrameError(400, f"malformed header line: {line!r}")
+        headers[name.strip().lower()] = value.strip()
+    if headers.get("transfer-encoding"):
+        raise _FrameError(501, "chunked request bodies are not supported")
+    try:
+        length = int(headers.get("content-length", "0") or "0")
+    except ValueError:
+        raise _FrameError(400, "malformed Content-Length") from None
+    if length < 0 or length > MAX_BODY_BYTES:
+        raise _FrameError(413, f"request body of {length} bytes refused")
+    total = head_end + 4 + length
+    if len(buf) < total:
+        return None
+    body = bytes(buf[head_end + 4: total])
+    del buf[:total]
+    connection = headers.get("connection", "").lower()
+    keep = (
+        connection != "close"
+        if version == "HTTP/1.1"
+        else connection == "keep-alive"
+    )
+    return method, target, body, keep
+
+
+def _frame(status, body, content_type, watermark, trace_id, keep_alive):
+    """One HTTP/1.1 response as bytes — the same envelope headers the
+    threaded handler sends (X-Arena-Watermark / X-Arena-Trace-Id on
+    every response, /stats reads the pair from here)."""
+    reason = http.client.responses.get(status, "Unknown")
+    head = (
+        f"HTTP/1.1 {status} {reason}\r\n"
+        f"Content-Type: {content_type}\r\n"
+        f"Content-Length: {len(body)}\r\n"
+        f"X-Arena-Watermark: {watermark}\r\n"
+        f"X-Arena-Trace-Id: {trace_id}\r\n"
+        f"Connection: {'keep-alive' if keep_alive else 'close'}\r\n"
+        "\r\n"
+    )
+    return head.encode("latin-1") + body
+
+
+def _is_submit(target):
+    path = target.split("?", 1)[0]
+    return [p for p in path.split("/") if p] == ["submit"]
+
+
+class EventLoopFrontEnd:  # protocol: start->close
+    """Single-thread `selectors` event loop serving the wire read path.
+
+    Reads (GETs, POST /query) are answered inline on the loop — they
+    only touch immutable views and the byte cache, so the whole read
+    tier is one thread, no per-connection stacks, no handler thread
+    churn. POST /submit goes to a small blocking worker pool, because
+    `FrontDoor.submit` may block on admission backpressure and the
+    loop must never block; the pool's completions re-enter the loop
+    through a socketpair wakeup, and a connection's parser pauses
+    while its submit is in flight so responses stay in request order.
+
+    `start()` spawns the loop + workers; `close()` stops and joins
+    them and closes every socket. The owning `ArenaHTTPServer` drives
+    both ends of the protocol."""
+
+    def __init__(self, wire, host="127.0.0.1", port=0,
+                 submit_workers=DEFAULT_SUBMIT_WORKERS):
+        if submit_workers < 1:
+            raise ValueError(
+                f"submit_workers must be >= 1, got {submit_workers}"
+            )
+        self.wire = wire
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, port))
+        self._listen.listen(LISTEN_BACKLOG)
+        self._listen.setblocking(False)
+        self.host, self.port = self._listen.getsockname()[:2]
+        # The pool->loop completion channel: workers append under the
+        # lock and poke the socketpair; the loop drains both.
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._done = []  # guarded_by: _done_lock
+        self._done_lock = threading.Lock()
+        self._jobs = _JobQueue()
+        self._conns = set()  # loop-thread-only connection registry
+        self._stop = threading.Event()
+        self._thread = None
+        self._workers = []
+        self._num_workers = submit_workers
+
+    # --- lifecycle ---------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("event loop already started")
+        self._thread = threading.Thread(
+            target=self._run, name=LOOP_THREAD_NAME, daemon=True
+        )
+        self._workers = [
+            threading.Thread(
+                target=self._worker,
+                name=f"{SUBMIT_WORKER_PREFIX}{i}",
+                daemon=True,
+            )
+            for i in range(self._num_workers)
+        ]
+        self._thread.start()
+        for worker in self._workers:
+            worker.start()
+        return self
+
+    def close(self):
+        self._stop.set()
+        for _worker in self._workers:
+            self._jobs.put(None)  # one poison pill per worker
+        self._wake()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        for worker in self._workers:
+            worker.join(timeout=10.0)
+        self._workers = []
+        self._listen.close()
+        self._wake_r.close()
+        self._wake_w.close()
+
+    # --- the loop ----------------------------------------------------
+
+    def _run(self):
+        sel = selectors.DefaultSelector()
+        sel.register(self._listen, selectors.EVENT_READ, _ACCEPT)
+        sel.register(self._wake_r, selectors.EVENT_READ, _WAKE)
+        try:
+            while not self._stop.is_set():
+                for key, mask in sel.select(timeout=SELECT_TIMEOUT_S):
+                    data = key.data
+                    if data is _ACCEPT:
+                        self._accept(sel)
+                    elif data is _WAKE:
+                        self._drain_done(sel)
+                    else:
+                        if data.closed:
+                            continue
+                        if mask & selectors.EVENT_WRITE:
+                            self._on_writable(sel, data)
+                        if mask & selectors.EVENT_READ and not data.closed:
+                            self._on_readable(sel, data)
+        finally:
+            for conn in list(self._conns):
+                self._drop(sel, conn)
+            sel.close()
+
+    def _accept(self, sel):
+        while True:
+            try:
+                sock, _addr = self._listen.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(sock)
+            self._conns.add(conn)
+            sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _on_readable(self, sel, conn):
+        try:
+            chunk = conn.sock.recv(RECV_BYTES)
+        except BlockingIOError:
+            return
+        except OSError:
+            self._drop(sel, conn)
+            return
+        if not chunk:  # peer closed
+            self._drop(sel, conn)
+            return
+        conn.inbuf += chunk
+        self._advance(sel, conn)
+
+    def _on_writable(self, sel, conn):
+        if conn.outbuf:
+            try:
+                with memoryview(conn.outbuf) as view:
+                    sent = conn.sock.send(view)
+            except BlockingIOError:
+                return
+            except OSError:
+                self._drop(sel, conn)
+                return
+            del conn.outbuf[:sent]
+        self._update_events(sel, conn)
+
+    def _advance(self, sel, conn):
+        """Parse-and-answer every complete request buffered on `conn`
+        (keep-alive pipelining), pausing while a submit is pooled so
+        responses keep request order."""
+        while not conn.busy and not conn.closed:
+            try:
+                req = _parse_request(conn)
+            except _FrameError as exc:
+                body = json.dumps({"error": exc.message}).encode("utf-8")
+                conn.outbuf += _frame(
+                    exc.status, body, "application/json", 0, 0,
+                    keep_alive=False,
+                )
+                conn.close_after = True
+                break
+            if req is None:
+                break
+            method, target, body, keep = req
+            if not keep:
+                conn.close_after = True
+            if method == "POST" and _is_submit(target):
+                conn.busy = True
+                self._jobs.put((conn, method, target, body, keep))
+                break
+            conn.outbuf += _frame(
+                *self._handle(method, target, body), keep_alive=keep
+            )
+            if conn.close_after:
+                break
+        self._update_events(sel, conn)
+
+    def _handle(self, method, target, body):
+        """One request through the shared wire core; a crash anywhere
+        degrades to a structured 500 (the loop thread must survive)."""
+        try:
+            return self.wire.handle_request(method, target, body)
+        except Exception as exc:  # noqa: BLE001 — front-end last resort
+            detail = json.dumps(
+                {"error": f"{type(exc).__name__}: {exc}"}
+            ).encode("utf-8")
+            return 500, detail, "application/json", 0, 0
+
+    def _update_events(self, sel, conn):
+        if conn.closed:
+            return
+        if conn.close_after and not conn.outbuf and not conn.busy:
+            self._drop(sel, conn)
+            return
+        events = selectors.EVENT_READ
+        if conn.outbuf:
+            events |= selectors.EVENT_WRITE
+        if events != conn.events:
+            conn.events = events
+            sel.modify(conn.sock, events, conn)
+
+    def _drop(self, sel, conn):
+        if conn.closed:
+            return
+        conn.closed = True
+        try:
+            sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self._conns.discard(conn)
+
+    # --- the submit pool ---------------------------------------------
+
+    def _worker(self):
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            conn, method, target, body, keep = job
+            frame = _frame(*self._handle(method, target, body),
+                           keep_alive=keep)
+            with self._done_lock:
+                self._done.append((conn, frame))
+            self._wake()
+
+    def _drain_done(self, sel):
+        while True:
+            try:
+                if not self._wake_r.recv(4096):
+                    break
+            except (BlockingIOError, OSError):
+                break
+        with self._done_lock:
+            done, self._done = self._done, []
+        for conn, frame in done:
+            if conn.closed:
+                continue
+            conn.busy = False
+            conn.outbuf += frame
+            self._advance(sel, conn)
+
+    def _wake(self):
+        try:
+            self._wake_w.send(b"\x01")
+        except OSError:
+            pass
+
+
+class _JobQueue:
+    """Tiny blocking FIFO (Condition + list): the loop enqueues submit
+    jobs without blocking, workers block on `get`."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []  # guarded_by: _cv
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify()
+
+    def get(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()
+            return self._items.pop(0)

@@ -32,10 +32,17 @@ ENDPOINTS = (
     # profiler stacks, and trace resolution — all GET, all wearing the
     # standard envelope.
     "debug_window", "debug_slo", "debug_profile", "debug_trace",
+    # The batched read endpoint (PR 16): one POST carrying many
+    # leaderboard/player/h2h lookups, every one answered from ONE view.
+    "query",
 )
 
 # Default leaderboard page when the query string omits one.
 DEFAULT_PAGE_LIMIT = 50
+
+# Batched /query bound: a request is one view read, not a denial-of-
+# service vector — more lookups than this is a 400, not a slow answer.
+MAX_BATCH_QUERIES = 1024
 
 
 class ProtocolError(ValueError):
@@ -95,6 +102,9 @@ def parse_path(method, path):
     elif route == "submit" and len(parts) == 1:
         endpoint, want = "submit", "POST"
         parsed = {}
+    elif route == "query" and len(parts) == 1:
+        endpoint, want = "query", "POST"
+        parsed = {}
     elif (
         route == "debug"
         and len(parts) == 2
@@ -150,6 +160,85 @@ def parse_submit_body(raw):
     return out[0], out[1], producer
 
 
+def _plain_int(value):
+    return isinstance(value, int) and not isinstance(value, bool)
+
+
+def parse_query_body(raw):
+    """Validate a batched read body into a list of query specs.
+
+    The body is ``{"queries": [{"leaderboard": [offset, limit]?,
+    "players": [ids]?, "pairs": [[a, b]...]?}, ...]}`` — each spec
+    must name at least one lookup, and the list is bounded by
+    `MAX_BATCH_QUERIES`. Range validation (ids within the roster,
+    non-negative pages) happens in `ArenaServer.query_batch`, where
+    the serving tier's own reject posture applies."""
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as exc:
+        raise ProtocolError(400, f"query body is not JSON: {exc}") from None
+    if not isinstance(doc, dict):
+        raise ProtocolError(400, "query body must be a JSON object")
+    queries = doc.get("queries")
+    if not isinstance(queries, list) or not queries:
+        raise ProtocolError(
+            400, "query field 'queries' must be a non-empty list"
+        )
+    if len(queries) > MAX_BATCH_QUERIES:
+        raise ProtocolError(
+            400,
+            f"query batch carries {len(queries)} lookups, "
+            f"max is {MAX_BATCH_QUERIES}",
+        )
+    specs = []
+    for i, q in enumerate(queries):
+        if not isinstance(q, dict):
+            raise ProtocolError(400, f"queries[{i}] must be a JSON object")
+        unknown = sorted(set(q) - {"leaderboard", "players", "pairs"})
+        if unknown:
+            raise ProtocolError(
+                400, f"queries[{i}] has unknown fields: {unknown}"
+            )
+        spec = {}
+        if "leaderboard" in q:
+            page = q["leaderboard"]
+            if (
+                not isinstance(page, list)
+                or len(page) != 2
+                or not all(_plain_int(v) for v in page)
+            ):
+                raise ProtocolError(
+                    400,
+                    f"queries[{i}].leaderboard must be [offset, limit]",
+                )
+            spec["leaderboard"] = (page[0], page[1])
+        if "players" in q:
+            ids = q["players"]
+            if not isinstance(ids, list) or not all(
+                _plain_int(v) for v in ids
+            ):
+                raise ProtocolError(
+                    400, f"queries[{i}].players must be a list of integers"
+                )
+            spec["players"] = list(ids)
+        if "pairs" in q:
+            pairs = q["pairs"]
+            if not isinstance(pairs, list) or not all(
+                isinstance(p, list)
+                and len(p) == 2
+                and all(_plain_int(v) for v in p)
+                for p in pairs
+            ):
+                raise ProtocolError(
+                    400, f"queries[{i}].pairs must be a list of [a, b] pairs"
+                )
+            spec["pairs"] = [(p[0], p[1]) for p in pairs]
+        if not spec:
+            raise ProtocolError(400, f"queries[{i}] names no lookups")
+        specs.append(spec)
+    return specs
+
+
 def make_response(payload, *, watermark, trace_id):
     """The response envelope: the payload dict plus the staleness
     watermark and the request's trace id, side by side in EVERY JSON
@@ -177,12 +266,18 @@ class WireClient:
         self.port = port
         self.timeout = timeout
         self._conn = None
+        # How many TCP connections this client has opened: a reuse
+        # regression (e.g. an endpoint that closes after every POST)
+        # shows up as this number tracking the request count instead
+        # of staying at 1.
+        self.connections_opened = 0
 
     def _connect(self):
         if self._conn is None:
             self._conn = http.client.HTTPConnection(
                 self.host, self.port, timeout=self.timeout
             )
+            self.connections_opened += 1
         return self._conn
 
     def _request(self, method, path, body=None):
@@ -219,6 +314,13 @@ class WireClient:
     def post(self, path, doc):
         status, payload, _headers = self._request("POST", path, body=doc)
         return status, payload
+
+    def batch_query(self, queries):
+        """POST many lookups as ONE /query request on the persistent
+        connection. `queries` is a list of spec dicts (the
+        `parse_query_body` schema); the response's "results" list is
+        index-aligned with it, every entry answered from one view."""
+        return self.post("/query", {"queries": list(queries)})
 
     def submit(self, winners, losers, producer="local"):
         """POST one batch to /submit (ids coerced to plain ints)."""

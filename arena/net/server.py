@@ -1,12 +1,13 @@
 """HTTP/JSON wire server: the network face of `ArenaServer`.
 
-A stdlib `ThreadingHTTPServer` (no new dependencies) exposing the
-already-JSON-shaped serving responses over six endpoints:
+Stdlib only (no new dependencies), exposing the already-JSON-shaped
+serving responses over these endpoints:
 
     GET  /healthz                     liveness + applied watermark
     GET  /leaderboard?offset=&limit=  one descending-rating page
     GET  /player/{id}                 one player's rating row (+ CI)
     GET  /h2h?a=&b=                   Elo P(a beats b)
+    POST /query                       many lookups, ONE view (batched)
     POST /submit                      admit one batch at the front door
     GET  /stats                       the registry's Prometheus render()
     GET  /debug/window                sliding-window rates + quantiles
@@ -19,7 +20,7 @@ span, and counter treatment as every other endpoint (the audit's
 debug-endpoint-omits-envelope mutant pins that), served from the
 `Observability` the registry already lives in. `start()` starts the
 ops-plane threads (window rotation + profiler sampling) next to the
-accept loop; `close()` stops them.
+front end; `close()` stops them.
 
 One request reads ONE immutable `ServingView` (the `ArenaServer.query`
 contract — the handler never touches engine internals), and every JSON
@@ -36,12 +37,23 @@ as one trace from the id in the response. Requests land in
 latency histogram through the server's ONE registry (the same schema
 `stats()`, `/stats`, and the frontend bench read).
 
-Threading: `ThreadingHTTPServer` gives one daemon thread per
-connection (HTTP/1.1 keep-alive, so a frontend holds one thread, not
-one per request). Query handlers are read-only against immutable
-views; `/submit` serializes through the front door's admission lock.
-The jitted work never runs on a handler thread — submit hands the
-batch to the front door's merge worker and returns the ticket.
+**The fast wire path (PR 16).** `handle_request` is the one
+transport-agnostic request core; two front ends drive it:
+
+- the default `EventLoopFrontEnd` (`arena.net.fastpath`): a single
+  `selectors` loop answers every read inline and hands only POST
+  /submit to a small blocking pool (the front door's admission may
+  block; its sequencing semantics are untouched);
+- the legacy `ThreadingHTTPServer` (``fastpath_reads=False``): one
+  daemon thread per connection, same core, same responses.
+
+Reads on leaderboard/player/h2h are served from the watermark-keyed
+byte cache (`ResponseCache`): rendered once per (endpoint, params,
+view generation), invalidated structurally when the view changes, and
+completed with each request's own trace id by a byte splice. Hot
+leaderboard pages are prerendered into the cache at view-refresh time
+through `ArenaServer.add_refresh_listener`. Which front end answered
+is observable: /healthz reports ``front_end``.
 """
 
 import json
@@ -49,7 +61,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from arena.net import protocol
+from arena.net import fastpath, protocol
 
 # Submit responses are 202 (accepted into the total order, applied
 # asynchronously) — the wire mirrors the front door's semantics.
@@ -69,47 +81,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         self._handle("POST")
 
-    # --- request plumbing --------------------------------------------
-
     def _handle(self, method):
         wire = self.server.wire
-        obs = wire.obs
-        t0 = time.perf_counter()
-        endpoint = "unmatched"
-        trace_id = 0
         # Drain the request body FIRST, unconditionally: on a keep-
         # alive connection an unread body would be parsed as the next
         # request's request line (every error path would poison the
         # connection behind it).
         length = int(self.headers.get("Content-Length") or 0)
         body_raw = self.rfile.read(length) if length else b""
-        try:
-            endpoint, params = protocol.parse_path(method, self.path)
-            with obs.span(f"net.{endpoint}") as root:
-                trace_id = root.trace_id
-                status, payload = self._dispatch(
-                    wire, endpoint, params, body_raw
-                )
-        except protocol.ProtocolError as exc:
-            status, payload = exc.status, {"error": str(exc)}
-        except ValueError as exc:
-            # The serving/admission reject posture (bad ids, malformed
-            # arrays): the caller's fault, named, no state change.
-            status, payload = 400, {"error": str(exc)}
-        except Exception as exc:  # noqa: BLE001 — a handler crash must
-            # degrade to a structured 500, never a dropped connection.
-            status, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
-        watermark = wire.server.engine.matches_applied
-        if payload is None:  # /stats: Prometheus text, envelope in headers
-            body = wire.render().encode("utf-8")
-            content_type = "text/plain; version=0.0.4"
-        else:
-            body = json.dumps(
-                protocol.make_response(
-                    payload, watermark=watermark, trace_id=trace_id
-                )
-            ).encode("utf-8")
-            content_type = "application/json"
+        status, body, content_type, watermark, trace_id = wire.handle_request(
+            method, self.path, body_raw
+        )
         try:
             self.send_response(status)
             self.send_header("Content-Type", content_type)
@@ -119,127 +101,267 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionError):
-            status = 499  # client went away mid-response (nginx's code)
+            pass  # client went away mid-response; already counted
+
+
+def _dispatch(wire, endpoint, params, body_raw):
+    """The non-cached endpoint switch: returns (status, payload) where
+    payload None means the Prometheus text body."""
+    srv = wire.server
+    if endpoint == "healthz":
+        return 200, {
+            "status": "ok",
+            "front_end": wire.front_end,
+            "players": srv.engine.num_players,
+            "matches_ingested": srv.engine.matches_ingested,
+        }
+    if endpoint == "stats":
+        return 200, None  # body rendered from the registry
+    if endpoint == "leaderboard":
+        return 200, srv.query(
+            leaderboard=(params["offset"], params["limit"])
+        )
+    if endpoint == "player":
+        return 200, srv.query(players=[params["player"]])
+    if endpoint == "h2h":
+        return 200, srv.query(pairs=[(params["a"], params["b"])])
+    if endpoint == "query":
+        return 200, srv.query_batch(protocol.parse_query_body(body_raw))
+    if endpoint == "submit":
+        return _submit(wire, body_raw)
+    if endpoint == "debug_window":
+        return 200, wire.obs.windows.read()
+    if endpoint == "debug_slo":
+        return 200, wire.obs.slo.evaluate()
+    if endpoint == "debug_profile":
+        return 200, wire.obs.profiler.snapshot()
+    if endpoint == "debug_trace":
+        return 200, _trace_payload(wire, params["trace_id"])
+    raise protocol.ProtocolError(404, f"no such endpoint: {endpoint!r}")
+
+
+def _trace_payload(wire, trace_id):
+    """Resolve one trace id (a response's `trace_id`, an SLO
+    alert's exemplar) into its recorded spans. 404 when the ring
+    kept nothing for it — evicted or never allocated. The payload
+    key is `queried_trace_id`: the envelope's own `trace_id` slot
+    belongs to THIS request's trace, authoritatively."""
+    spans = wire.obs.tracer.trace(trace_id)
+    if not spans:
+        raise protocol.ProtocolError(
+            404, f"no spans recorded for trace {trace_id}"
+        )
+    return {
+        "queried_trace_id": trace_id,
+        "spans": [
+            {
+                "name": r.name,
+                "start": r.start,
+                "duration": r.duration,
+                "tid": r.tid,
+                "span_id": r.span_id,
+                "parent_id": r.parent_id,
+            }
+            for r in spans
+        ],
+    }
+
+
+def _submit(wire, body_raw):
+    frontdoor = wire.frontdoor
+    if frontdoor is None:
+        raise protocol.ProtocolError(
+            503, "this server has no front door (read-only replica)"
+        )
+    winners, losers, producer = protocol.parse_submit_body(body_raw)
+    seq = frontdoor.submit(winners, losers, producer=producer)
+    return STATUS_ACCEPTED, {
+        "seq": seq,
+        "producer": producer,
+        "matches": int(winners.shape[0]),
+        "pending_batches": frontdoor.pending_batches(),
+    }
+
+
+class ArenaHTTPServer:  # protocol: start->close
+    """The wire tier: one front end over one `ArenaServer` (+ optionally
+    one `FrontDoor` for the submit path; without one the server is a
+    read-only replica and /submit answers 503).
+
+    ``fastpath_reads=True`` (the default) serves through the
+    `selectors` event loop; ``False`` falls back to the legacy
+    `ThreadingHTTPServer`. Both share `handle_request`, the byte
+    cache, and every metric. ``cache_capacity=0`` disables the cache
+    (every read renders fresh). `port=0` binds an ephemeral port
+    (tests/bench); `self.port` is the bound one either way. `start()`
+    serves on daemon threads; `close()` shuts down and joins. Usable
+    as a context manager."""
+
+    def __init__(self, server, frontdoor=None, host="127.0.0.1", port=0,
+                 fastpath_reads=True,
+                 cache_capacity=fastpath.DEFAULT_CACHE_CAPACITY,
+                 prerender_pages=fastpath.DEFAULT_PRERENDER_PAGES,
+                 submit_workers=fastpath.DEFAULT_SUBMIT_WORKERS):
+        self.server = server
+        self.frontdoor = frontdoor
+        self.obs = server.obs
+        self.cache = (
+            fastpath.ResponseCache(self.obs, capacity=cache_capacity)
+            if cache_capacity > 0
+            else None
+        )
+        self._prerender_pages = tuple(prerender_pages)
+        self._httpd = None
+        self._loop = None
+        if fastpath_reads:
+            self._loop = fastpath.EventLoopFrontEnd(
+                self, host=host, port=port, submit_workers=submit_workers
+            )
+            self.host, self.port = self._loop.host, self._loop.port
+        else:
+            self._httpd = ThreadingHTTPServer((host, port), _Handler)
+            self._httpd.daemon_threads = True
+            self._httpd.wire = self
+            self.host, self.port = self._httpd.server_address[:2]
+        self._thread = None
+        if self.cache is not None:
+            # Prerender hot leaderboard pages at every view refresh:
+            # they change exactly once per refresh and everyone reads
+            # them, so the bytes exist before the first reader misses.
+            self.server.add_refresh_listener(self._prerender)
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def front_end(self):
+        """Which transport answers reads: "eventloop" (the selectors
+        loop) or "threaded" (the legacy thread-per-connection server).
+        /healthz reports this — a silent fallback is a test failure,
+        not a deploy surprise."""
+        return "eventloop" if self._loop is not None else "threaded"
+
+    def render(self):
+        """The /stats body: the registry's Prometheus exposition."""
+        return self.obs.render()
+
+    # --- the transport-agnostic request core -------------------------
+
+    def handle_request(self, method, path, body_raw):
+        """One wire request, whatever the transport: route, span,
+        dispatch (through the byte cache for the cacheable GETs),
+        envelope, count. Returns (status, body_bytes, content_type,
+        watermark, trace_id) ready for framing.
+
+        The envelope watermark is the payload's own view watermark
+        when the payload carries one (query responses: the watermark
+        of the ONE view that answered), else the engine's applied
+        watermark (liveness/submit/error responses)."""
+        obs = self.obs
+        t0 = time.perf_counter()
+        endpoint = "unmatched"
+        trace_id = 0
+        payload = None
+        head = None
+        watermark = None
+        try:
+            endpoint, params = protocol.parse_path(method, path)
+            with obs.span(f"net.{endpoint}") as root:
+                trace_id = root.trace_id
+                if (
+                    self.cache is not None
+                    and endpoint in fastpath.CACHEABLE_ENDPOINTS
+                ):
+                    status, head, watermark = fastpath.serve_cached(
+                        self, endpoint, params
+                    )
+                else:
+                    status, payload = _dispatch(
+                        self, endpoint, params, body_raw
+                    )
+        except protocol.ProtocolError as exc:
+            status, payload, head = exc.status, {"error": str(exc)}, None
+        except ValueError as exc:
+            # The serving/admission reject posture (bad ids, malformed
+            # arrays): the caller's fault, named, no state change.
+            status, payload, head = 400, {"error": str(exc)}, None
+        except Exception as exc:  # noqa: BLE001 — a handler crash must
+            # degrade to a structured 500, never a dropped connection.
+            status, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
+            head = None
+        if watermark is None:
+            if payload is not None and "watermark" in payload:
+                watermark = payload["watermark"]
+            else:
+                watermark = self.server.engine.matches_applied
+        if head is not None:
+            body = fastpath.complete_response(head, trace_id)
+            content_type = "application/json"
+        elif payload is None:  # /stats: Prometheus text, envelope in headers
+            body = self.render().encode("utf-8")
+            content_type = "text/plain; version=0.0.4"
+        else:
+            body = json.dumps(
+                protocol.make_response(
+                    payload, watermark=watermark, trace_id=trace_id
+                )
+            ).encode("utf-8")
+            content_type = "application/json"
         obs.counter(
             "arena_http_requests_total", endpoint=endpoint, status=str(status)
         ).inc()
         obs.histogram(
             "arena_http_request_latency_seconds", endpoint=endpoint
         ).record(time.perf_counter() - t0, trace_id=trace_id)
+        return status, body, content_type, watermark, trace_id
 
-    def _dispatch(self, wire, endpoint, params, body_raw):
-        srv = wire.server
-        if endpoint == "healthz":
-            return 200, {
-                "status": "ok",
-                "players": srv.engine.num_players,
-                "matches_ingested": srv.engine.matches_ingested,
-            }
-        if endpoint == "stats":
-            return 200, None  # body rendered from the registry
-        if endpoint == "leaderboard":
-            return 200, srv.query(
-                leaderboard=(params["offset"], params["limit"])
+    # --- cache plumbing ----------------------------------------------
+
+    def _prerender(self, view):
+        """View-refresh listener: rebuild the hot leaderboard pages'
+        bytes for the fresh view. Runs under the serving lock, so the
+        pages are in the cache before the refresh is observable."""
+        srv = self.server
+        staleness = view.matches_ingested - view.watermark
+        for offset, limit in self._prerender_pages:
+            params = {"offset": offset, "limit": limit}
+            payload = fastpath.render_query_payload(
+                srv, view, False, "leaderboard", params, staleness=staleness
             )
-        if endpoint == "player":
-            return 200, srv.query(players=[params["player"]])
-        if endpoint == "h2h":
-            return 200, srv.query(pairs=[(params["a"], params["b"])])
-        if endpoint == "submit":
-            return self._submit(wire, body_raw)
-        if endpoint == "debug_window":
-            return 200, wire.obs.windows.read()
-        if endpoint == "debug_slo":
-            return 200, wire.obs.slo.evaluate()
-        if endpoint == "debug_profile":
-            return 200, wire.obs.profiler.snapshot()
-        if endpoint == "debug_trace":
-            return 200, self._trace_payload(wire, params["trace_id"])
-        raise protocol.ProtocolError(404, f"no such endpoint: {endpoint!r}")
-
-    def _trace_payload(self, wire, trace_id):
-        """Resolve one trace id (a response's `trace_id`, an SLO
-        alert's exemplar) into its recorded spans. 404 when the ring
-        kept nothing for it — evicted or never allocated. The payload
-        key is `queried_trace_id`: the envelope's own `trace_id` slot
-        belongs to THIS request's trace, authoritatively."""
-        spans = wire.obs.tracer.trace(trace_id)
-        if not spans:
-            raise protocol.ProtocolError(
-                404, f"no spans recorded for trace {trace_id}"
+            head = fastpath.render_head(payload, view.watermark)
+            self.cache.put(
+                fastpath.cache_key("leaderboard", params), view.seq, head,
+                prerendered=True,
             )
-        return {
-            "queried_trace_id": trace_id,
-            "spans": [
-                {
-                    "name": r.name,
-                    "start": r.start,
-                    "duration": r.duration,
-                    "tid": r.tid,
-                    "span_id": r.span_id,
-                    "parent_id": r.parent_id,
-                }
-                for r in spans
-            ],
-        }
 
-    def _submit(self, wire, body_raw):
-        frontdoor = wire.frontdoor
-        if frontdoor is None:
-            raise protocol.ProtocolError(
-                503, "this server has no front door (read-only replica)"
-            )
-        winners, losers, producer = protocol.parse_submit_body(body_raw)
-        seq = frontdoor.submit(winners, losers, producer=producer)
-        return STATUS_ACCEPTED, {
-            "seq": seq,
-            "producer": producer,
-            "matches": int(winners.shape[0]),
-            "pending_batches": frontdoor.pending_batches(),
-        }
+    def verify_cache_consistency(self):
+        """The cache-consistency hard gate (the frontend bench raises
+        on failure): every cached entry of the current view generation
+        must byte-equal a fresh render. Returns (checked, mismatches)."""
+        if self.cache is None:
+            return 0, []
+        return fastpath.verify_cache_consistency(self)
 
-
-class ArenaHTTPServer:  # protocol: start->close
-    """The wire tier: one `ThreadingHTTPServer` over one `ArenaServer`
-    (+ optionally one `FrontDoor` for the submit path; without one the
-    server is a read-only replica and /submit answers 503).
-
-    `port=0` binds an ephemeral port (tests/bench); `self.port` is the
-    bound one either way. `start()` serves on a daemon thread;
-    `close()` shuts down and joins. Usable as a context manager."""
-
-    def __init__(self, server, frontdoor=None, host="127.0.0.1", port=0):
-        self.server = server
-        self.frontdoor = frontdoor
-        self.obs = server.obs
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
-        self._httpd.daemon_threads = True
-        self._httpd.wire = self
-        self.host, self.port = self._httpd.server_address[:2]
-        self._thread = None
-
-    @property
-    def url(self):
-        return f"http://{self.host}:{self.port}"
-
-    def render(self):
-        """The /stats body: the registry's Prometheus exposition."""
-        return self.obs.render()
+    # --- lifecycle ---------------------------------------------------
 
     def start(self):
-        if self._thread is not None:
+        if self._started():
             raise RuntimeError("wire server already started")
         # The ops plane serves live at /debug/*: rotation + sampling
         # threads ride the wire server's lifecycle (no-op on NULL obs).
         self.obs.start_ops()
         try:
-            self._thread = threading.Thread(
-                target=self._httpd.serve_forever,
-                kwargs={"poll_interval": 0.05},
-                name="arena-wire-server",
-                daemon=True,
-            )
-            self._thread.start()
+            if self._loop is not None:
+                self._loop.start()
+            else:
+                self._thread = threading.Thread(
+                    target=self._httpd.serve_forever,
+                    kwargs={"poll_interval": 0.05},
+                    name="arena-wire-server",
+                    daemon=True,
+                )
+                self._thread.start()
         except BaseException:
             # A failed spawn must not strand the rotation/sampling
             # threads start_ops just launched: nobody holds a handle to
@@ -249,12 +371,23 @@ class ArenaHTTPServer:  # protocol: start->close
             raise
         return self
 
+    def _started(self):
+        if self._loop is not None:
+            return self._loop._thread is not None
+        return self._thread is not None
+
     def close(self):
-        if self._thread is not None:
-            self._httpd.shutdown()
-            self._thread.join(timeout=10.0)
-            self._thread = None
-        self._httpd.server_close()
+        if self.cache is not None:
+            self.server.remove_refresh_listener(self._prerender)
+            self.cache.close()
+        if self._loop is not None:
+            self._loop.close()
+        if self._httpd is not None:
+            if self._thread is not None:
+                self._httpd.shutdown()
+                self._thread.join(timeout=10.0)
+                self._thread = None
+            self._httpd.server_close()
         self.obs.stop_ops()
 
     def __enter__(self):

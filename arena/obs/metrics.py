@@ -109,6 +109,16 @@ HELP_TEXTS = {
         "shed batch sizes (exemplar: the dropped trace)",
     "arena_http_requests_total": "wire requests by endpoint and status",
     "arena_http_request_latency_seconds": "wire request latency",
+    "arena_wire_cache_hits_total": "wire responses served from cached bytes",
+    "arena_wire_cache_misses_total": "wire cache lookups that rendered fresh",
+    "arena_wire_cache_evictions_total":
+        "wire cache entries evicted (dead generation or capacity)",
+    "arena_wire_cache_prerenders_total":
+        "hot pages prerendered into the wire cache at view refresh",
+    "arena_wire_cache_age_seconds":
+        "age of the wire cache's current view generation",
+    "arena_view_listener_errors_total":
+        "view-refresh listener exceptions absorbed",
 }
 
 

@@ -48,6 +48,8 @@ ROLE_PATTERNS = (
     ("arena-ingest-packer", "packer"),
     ("arena-frontdoor-merge", "dispatcher"),
     ("arena-wire-server", "http-accept"),
+    ("arena-wire-eventloop", "http-eventloop"),  # the fast read path
+    ("arena-wire-submit-", "http-worker"),  # the event loop's submit pool
     ("Thread-", "http-worker"),  # stdlib ThreadingHTTPServer workers
     ("arena-obs-window", "window"),
     ("arena-obs-profiler", "profiler"),

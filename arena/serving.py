@@ -398,6 +398,11 @@ class ArenaServer:  # protocol: close
         self._seq = 0
         self._restoring = False
         self._intervals = None  # (lo, hi) ndarrays from the last bootstrap
+        # View-refresh listeners (the wire tier's prerender hook): fired
+        # under the lock right after a fresh view is published, so hot
+        # leaderboard bytes exist in the wire cache before any reader
+        # can miss on the new view.
+        self._refresh_listeners = []  # guarded_by: _lock
         # Serving counters live in the registry — ONE schema shared by
         # stats(), the Prometheus render(), and the soak bench line.
         reg = self.obs
@@ -413,6 +418,9 @@ class ArenaServer:  # protocol: close
         self._h_query_latency = reg.histogram("arena_query_latency_seconds")
         self._h_staleness = reg.histogram(
             "arena_query_staleness_matches", base=1.0
+        )
+        self._c_listener_errors = reg.counter(
+            "arena_view_listener_errors_total"
         )
         # The live ops plane (PR 13): windows + SLO engine + profiler
         # over the same registry. Construction only — no threads until
@@ -521,6 +529,26 @@ class ArenaServer:  # protocol: close
                 "shed_batches_by_policy": reg.counter_by_label(
                     "arena_pipeline_dropped_batches_total", "policy"
                 ),
+                # The wire byte cache (PR 16): effectiveness counters +
+                # the age of the current cache generation (seconds since
+                # the view it renders for was published). Zeros until a
+                # wire server with a cache runs; same registry either
+                # way, so render() and /debug/window see them too.
+                "cache": {
+                    "hits": reg.counter_sum("arena_wire_cache_hits_total"),
+                    "misses": reg.counter_sum(
+                        "arena_wire_cache_misses_total"
+                    ),
+                    "evictions": reg.counter_sum(
+                        "arena_wire_cache_evictions_total"
+                    ),
+                    "prerenders": reg.counter_sum(
+                        "arena_wire_cache_prerenders_total"
+                    ),
+                    "age_seconds": reg.gauge(
+                        "arena_wire_cache_age_seconds"
+                    ).value,
+                },
             },
             # The live ops plane (PR 13): burn-rate evaluation over
             # the sliding windows, plus window/profiler thread health.
@@ -568,7 +596,30 @@ class ArenaServer:  # protocol: close
             self._view = ServingView(ratings, watermark, store, lo, hi, self._seq)
             self._c_view_refreshes.inc()
             self._observe_sanitizers()
+            for listener in list(self._refresh_listeners):
+                try:
+                    listener(self._view)
+                except Exception:
+                    # A broken listener (e.g. a wire prerenderer) must
+                    # never take down view refresh — queries depend on
+                    # it. Counted, not raised.
+                    self._c_listener_errors.inc()
             return self._view
+
+    def add_refresh_listener(self, fn):
+        """Register `fn(view)` to run (under the serving lock) each
+        time a fresh view is published. The wire tier uses this to
+        prerender hot leaderboard pages into its byte cache at refresh
+        time; listener exceptions are absorbed into
+        `arena_view_listener_errors_total`."""
+        with self._lock:
+            self._refresh_listeners.append(fn)
+
+    def remove_refresh_listener(self, fn):
+        """Unregister a refresh listener (a no-op if absent)."""
+        with self._lock:
+            if fn in self._refresh_listeners:
+                self._refresh_listeners.remove(fn)
 
     def refresh_intervals(self, num_rounds=None, seed=None, alpha=0.05,
                           batch_size=8192, min_epoch_batches=None):
@@ -637,6 +688,68 @@ class ArenaServer:  # protocol: close
     def _query_into(self, qspan, t0, leaderboard, players, pairs):
         view, stale = self._serve_view()
         self._c_queries.inc()
+        out = self._query_parts(
+            view, stale, leaderboard, players, pairs, qspan.trace_id
+        )
+        # Latency + staleness distributions: the p50/p99 substrate the
+        # soak bench (and the network tier) reports. Host-side work
+        # only between the clock reads — every value served came from
+        # the prebuilt host view, nothing here awaits a device. The
+        # trace id rides into each bucket as its exemplar: "show me
+        # the trace behind the p99 bucket" resolves via tracer.trace().
+        latency = time.perf_counter() - t0
+        self._h_query_latency.record(latency, trace_id=qspan.trace_id)
+        self._h_staleness.record(out["staleness"], trace_id=qspan.trace_id)
+        return out
+
+    def query_batch(self, specs):
+        """Many lookups answered from ONE view.
+
+        Each spec is a dict with any of the `query()` keyword shapes —
+        "leaderboard": (offset, limit), "players": [ids...], "pairs":
+        [(a, b)...] — and the whole batch is rendered against a single
+        `_serve_view()` call, so every result shares one watermark, one
+        view_seq and one staleness number. This is the in-process
+        engine behind the wire's POST /query endpoint: N lookups cost
+        one staleness decision and one HTTP round trip instead of N.
+        An id out of range raises ValueError and nothing is served,
+        same as `query()`.
+        """
+        t0 = time.perf_counter()
+        with self.obs.span("serve.query_batch") as qspan:
+            view, stale = self._serve_view()
+            staleness = view.matches_ingested - view.watermark
+            results = []
+            for spec in specs:
+                results.append(self._query_parts(
+                    view, stale,
+                    spec.get("leaderboard"), spec.get("players"),
+                    spec.get("pairs"), qspan.trace_id,
+                    staleness=staleness,
+                ))
+            self._c_queries.inc(len(results))
+            latency = time.perf_counter() - t0
+            self._h_query_latency.record(latency, trace_id=qspan.trace_id)
+            self._h_staleness.record(staleness, trace_id=qspan.trace_id)
+            return {
+                "watermark": view.watermark,
+                "trace_id": qspan.trace_id,
+                "view_seq": view.seq,
+                "stale": stale,
+                "queries": len(results),
+                "results": results,
+            }
+
+    def _query_parts(self, view, stale, leaderboard, players, pairs,
+                     trace_id, staleness=None):
+        """Render one lookup's response parts against an already-chosen
+        view. Deterministic in (view, arguments) apart from the
+        engine's immutable Elo scale — the property the wire byte
+        cache stands on: same view + same arguments => same payload,
+        byte for byte. `staleness` defaults to the live ingest
+        distance (the `query()` contract); the wire fast path passes
+        the view-stable distance so cached bytes never embed a number
+        that drifts between identical renders."""
         num_players = view.ratings.size
         out = {
             "watermark": view.watermark,
@@ -645,9 +758,11 @@ class ArenaServer:  # protocol: close
             # is one tracer.trace(id) away from its causal story. The
             # wire tier's envelope re-stamps the same pair (the net
             # root span shares this trace).
-            "trace_id": qspan.trace_id,
+            "trace_id": trace_id,
             "matches_ingested": view.matches_ingested,
-            "staleness": self._staleness(view),
+            "staleness": (
+                self._staleness(view) if staleness is None else staleness
+            ),
             "stale": stale,
             "view_seq": view.seq,
             "view_ratings_sum": view.ratings_sum,
@@ -690,15 +805,6 @@ class ArenaServer:  # protocol: close
                     ),
                 })
             out["pairs"] = rows
-        # Latency + staleness distributions: the p50/p99 substrate the
-        # soak bench (and any future network tier) reports. Host-side
-        # work only between the clock reads — every value served came
-        # from the prebuilt host view, nothing here awaits a device.
-        # The trace id rides into each bucket as its exemplar: "show me
-        # the trace behind the p99 bucket" resolves via tracer.trace().
-        latency = time.perf_counter() - t0
-        self._h_query_latency.record(latency, trace_id=qspan.trace_id)
-        self._h_staleness.record(out["staleness"], trace_id=qspan.trace_id)
         return out
 
     def _player_row(self, view, p, rank=None):  # pure-render(view)

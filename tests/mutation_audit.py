@@ -630,10 +630,10 @@ MUTATIONS = (
     (
         "debug-endpoint-omits-envelope",
         "arena/net/server.py",
-        '        if endpoint == "debug_window":\n'
-        "            return 200, wire.obs.windows.read()",
-        '        if endpoint == "debug_window":\n'
-        "            return 200, None",
+        '    if endpoint == "debug_window":\n'
+        "        return 200, wire.obs.windows.read()",
+        '    if endpoint == "debug_window":\n'
+        "        return 200, None",
         "a None payload routes into the /stats Prometheus-text path: the "
         "response drops the JSON envelope (watermark + trace_id) and the "
         "ops plane silently stops honoring the wire contract every other "
@@ -728,6 +728,54 @@ MUTATIONS = (
         "would go red and the clean-tree gate with it — killed by "
         "test_pure_render_reading_only_its_view_lints_clean (and "
         "test_full_tree_lints_clean_with_concurrency_rules_active)",
+    ),
+    (
+        "cache-not-invalidated-on-watermark-advance",
+        "arena/net/fastpath.py",
+        "            entry = self._entries.get(key)\n"
+        "            if entry is not None and entry[0] == view_seq:",
+        "            entry = self._entries.get(key)\n"
+        "            if entry is not None:",
+        "the wire byte cache's whole correctness story is the generation "
+        "check: a `get` that ignores the view seq serves bytes rendered "
+        "from a DEAD view after the watermark advances — stale "
+        "leaderboards wearing a fresh-looking envelope — killed by "
+        "test_cache_invalidates_when_watermark_advances (a /player read "
+        "after an ingest advance must carry the new watermark)",
+    ),
+    (
+        "batch-endpoint-splits-views-across-one-request",
+        "arena/serving.py",
+        "            view, stale = self._serve_view()\n"
+        "            staleness = view.matches_ingested - view.watermark\n"
+        "            results = []\n"
+        "            for spec in specs:\n"
+        "                results.append(self._query_parts(",
+        "            results = []\n"
+        "            for spec in specs:\n"
+        "                view, stale = self._serve_view()\n"
+        "                staleness = view.matches_ingested - view.watermark\n"
+        "                results.append(self._query_parts(",
+        "the batch endpoint sells ONE view across every lookup in the "
+        "request (mutually consistent results); choosing a view per spec "
+        "lets concurrent ingest split one response across several views "
+        "— killed by test_batch_query_answers_every_part_from_one_view "
+        "(ingest advances after every refresh, so a per-spec choice "
+        "yields differing view_seqs)",
+    ),
+    (
+        "event-loop-read-falls-back-to-blocking-silently",
+        "arena/net/server.py",
+        "        if fastpath_reads:\n"
+        "            self._loop = fastpath.EventLoopFrontEnd(",
+        "        if fastpath_reads and False:  # quiet threaded fallback\n"
+        "            self._loop = fastpath.EventLoopFrontEnd(",
+        "the event loop is the perf tentpole's read front end; a silent "
+        "fallback to thread-per-connection passes every functional test "
+        "while quietly reverting the 10x — killed by "
+        "test_default_front_end_is_the_event_loop (/healthz must report "
+        "front_end == eventloop and the loop's named thread must be "
+        "live)",
     ),
 )
 

@@ -115,7 +115,7 @@ def test_default_targets_cover_the_ingest_and_pipeline_modules():
         "obs/context.py", "obs/debug.py", "obs/regress.py",
         "obs/windows.py", "obs/slo.py", "obs/profile.py",
         "net/__init__.py", "net/protocol.py", "net/frontdoor.py",
-        "net/server.py",
+        "net/server.py", "net/fastpath.py",
         "analysis/project.py", "analysis/concurrency.py",
     ):
         path = str(REPO / "arena" / mod)
@@ -138,6 +138,7 @@ def test_wire_handler_hot_path_lints_clean_while_corpus_twin_fires():
         str(REPO / "arena" / "net" / "server.py"),
         str(REPO / "arena" / "net" / "frontdoor.py"),
         str(REPO / "arena" / "net" / "protocol.py"),
+        str(REPO / "arena" / "net" / "fastpath.py"),
     ])
     assert real == [], "\n".join(f.format() for f in real)
 

@@ -76,7 +76,9 @@ def test_mutations_cover_every_policed_surface():
     the terminal-state transition, the one-hop helper-release
     credit), and since PR 15 the jaxlint v5 effect-contract analyzer
     (the call-graph fixpoint, the check-then-act re-check credit, the
-    pure-render parameter exemption)."""
+    pure-render parameter exemption), and since PR 16 the fast wire
+    path (the byte cache's view-generation check, the batch endpoint's
+    one-view contract, the event-loop read front end's default)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -99,6 +101,7 @@ def test_mutations_cover_every_policed_surface():
         "arena/net/frontdoor.py",
         "arena/net/protocol.py",
         "arena/net/server.py",
+        "arena/net/fastpath.py",
     }
 
 
@@ -141,6 +144,7 @@ def _fake_sources_only(dest):
         "arena/net/frontdoor.py",
         "arena/net/protocol.py",
         "arena/net/server.py",
+        "arena/net/fastpath.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)

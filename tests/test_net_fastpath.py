@@ -1,0 +1,505 @@
+"""The fast wire path (PR 16): watermark-keyed byte cache, batched
+/query endpoint, and the selectors event-loop front end.
+
+The contract under test, end to end over real localhost HTTP:
+
+- cached responses are BYTE-identical to a fresh render at the same
+  watermark (the head-splice property: only the per-request trace id
+  differs, spliced in after the cached head);
+- a watermark advance guarantees invalidation — the next read serves
+  the new view, never yesterday's bytes (the audit's
+  cache-not-invalidated-on-watermark-advance mutant dies here);
+- the stale flag passes through during restore, uncached in both
+  directions;
+- one batched POST /query answers every lookup from ONE view (the
+  audit's batch-endpoint-splits-views-across-one-request mutant);
+- the event loop is the DEFAULT read front end, observable via
+  /healthz and its named thread (the audit's
+  event-loop-read-falls-back-to-blocking-silently mutant);
+- 8 reader threads hammering the cache while ingest advances the view
+  never see a torn response or a watermark regression.
+"""
+
+import json
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from arena.net import fastpath, protocol
+from arena.net.fastpath import (
+    ResponseCache,
+    cache_key,
+    complete_response,
+    render_head,
+)
+from arena.net.protocol import (
+    MAX_BATCH_QUERIES,
+    ProtocolError,
+    WireClient,
+    make_response,
+    parse_query_body,
+)
+from arena.net.server import ArenaHTTPServer
+from arena.obs import NULL, Observability
+from arena.serving import ArenaServer
+
+PLAYERS = 24
+
+
+def _ingest(srv, n, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, PLAYERS, n).astype(np.int32)
+    b = (a + 1 + rng.integers(0, PLAYERS - 1, n)).astype(np.int32) % PLAYERS
+    srv.engine.ingest(a, b)
+
+
+@pytest.fixture(scope="module")
+def wire():
+    """One event-loop wire server over a max_staleness=0 ArenaServer
+    (every ingest advance forces a refresh on the next read)."""
+    obs = Observability()
+    srv = ArenaServer(num_players=PLAYERS, max_staleness_matches=0, obs=obs)
+    _ingest(srv, 300)
+    server = ArenaHTTPServer(srv).start()
+    client = WireClient(server.host, server.port)
+    yield server, client
+    client.close()
+    server.close()
+    srv.close()
+
+
+# --- the byte-splice property (pure) ----------------------------------------
+
+
+def test_head_splice_is_byte_identical_to_a_fresh_envelope_dump():
+    """The property the whole cache stands on: a cached head completed
+    with a request's trace id equals `json.dumps(make_response(...))`
+    for that trace id, byte for byte — for any payload, including ones
+    carrying their own (stripped) watermark/trace pair."""
+    payloads = [
+        {"leaderboard": [{"player": 3, "rating": 1501.25, "lo": None}]},
+        {"x": 1, "watermark": 999, "trace_id": 999},
+        {"stale": False, "nested": {"a": [1, 2, 3]}, "f": 0.1 + 0.2},
+    ]
+    for payload in payloads:
+        for trace_id in (1, 7, 123456789):
+            head = render_head(payload, watermark=42)
+            fresh = json.dumps(
+                make_response(payload, watermark=42, trace_id=trace_id)
+            ).encode("utf-8")
+            assert complete_response(head, trace_id) == fresh
+
+
+def test_cached_bytes_equal_fresh_render_at_same_watermark(wire):
+    """Same watermark, same params: the cached response and a fresh
+    render agree on every byte except the trace id — asserted through
+    the same consistency gate the frontend bench hard-fails on."""
+    server, client = wire
+    srv = server.server
+    _status, first = client.get("/leaderboard?offset=0&limit=6")
+    hits_before = srv.obs.registry.counter_sum("arena_wire_cache_hits_total")
+    _status, second = client.get("/leaderboard?offset=0&limit=6")
+    hits_after = srv.obs.registry.counter_sum("arena_wire_cache_hits_total")
+    assert hits_after > hits_before, "second read should be a cache hit"
+    assert second["trace_id"] != first["trace_id"]
+    assert {k: v for k, v in second.items() if k != "trace_id"} == {
+        k: v for k, v in first.items() if k != "trace_id"
+    }
+    checked, mismatches = server.verify_cache_consistency()
+    assert checked >= 1
+    assert mismatches == []
+
+
+def test_cache_invalidates_when_watermark_advances(wire):
+    """Named kill for the audit's
+    cache-not-invalidated-on-watermark-advance mutant (a `get` that
+    ignores the view generation): after the watermark advances, the
+    same read serves the NEW view — watermark, ingest count, and rows
+    all fresh, never yesterday's bytes. Uses /player (not a
+    prerendered page, so a stale-serving `get` cannot be rescued by
+    the refresh-time prerender refill)."""
+    server, client = wire
+    srv = server.server
+    status, before = client.get("/player/3")
+    assert status == 200
+    assert before["watermark"] == srv.engine.matches_applied
+    _ingest(srv, 40, seed=99)  # advances the watermark; staleness bound 0
+    status, after = client.get("/player/3")
+    assert status == 200
+    assert after["watermark"] == srv.engine.matches_applied
+    assert after["watermark"] > before["watermark"]
+    assert after["matches_ingested"] > before["matches_ingested"]
+    assert after["view_seq"] > before["view_seq"]
+    # And the fresh bytes are themselves cached + consistent.
+    checked, mismatches = server.verify_cache_consistency()
+    assert checked >= 1 and mismatches == []
+
+
+def test_stale_flag_passes_through_during_restore(wire):
+    """While a restore is in flight the serving tier answers from the
+    last complete view with stale=true — the cache must not launder
+    that into a fresh-looking stale=false hit, nor cache the stale
+    render for later."""
+    server, client = wire
+    srv = server.server
+    _status, fresh = client.get("/h2h?a=1&b=2")
+    assert fresh["stale"] is False
+    srv._restoring = True
+    try:
+        _ingest(srv, 10, seed=7)
+        status, stale = client.get("/h2h?a=1&b=2")
+        assert status == 200
+        assert stale["stale"] is True
+        assert stale["staleness"] > 0
+    finally:
+        srv._restoring = False
+    # Back to normal: the stale render was NOT cached — the next read
+    # reflects the post-restore view, stale=false again.
+    _status, after = client.get("/h2h?a=1&b=2")
+    assert after["stale"] is False
+    assert after["watermark"] == srv.engine.matches_applied
+
+
+def test_prerendered_hot_pages_hit_without_a_prior_read(wire):
+    """Satellite (c): refresh_view prerenders the hot leaderboard
+    pages, so the FIRST wire read of a fresh view's top page is
+    already a cache hit."""
+    server, client = wire
+    srv = server.server
+    reg = srv.obs.registry
+    _ingest(srv, 10, seed=11)
+    srv.refresh_view()  # fires the prerender listener
+    pre = reg.counter_sum("arena_wire_cache_prerenders_total")
+    assert pre >= len(server._prerender_pages)
+    hits_before = reg.counter_sum("arena_wire_cache_hits_total")
+    offset, limit = server._prerender_pages[0]
+    status, page = client.get(f"/leaderboard?offset={offset}&limit={limit}")
+    assert status == 200
+    assert reg.counter_sum("arena_wire_cache_hits_total") > hits_before
+    ratings = [row["rating"] for row in page["leaderboard"]]
+    assert ratings == sorted(ratings, reverse=True)
+
+
+# --- the batched /query endpoint --------------------------------------------
+
+
+def test_batch_query_answers_every_part_from_one_view():
+    """Named kill for the audit's
+    batch-endpoint-splits-views-across-one-request mutant (a per-spec
+    `_serve_view()`): with ingest advancing after every refresh and a
+    zero staleness bound, a per-spec view choice would hand each spec
+    a DIFFERENT view_seq — the batch contract is one view, one
+    watermark, one seq across all results."""
+    srv = ArenaServer(num_players=PLAYERS, max_staleness_matches=0, obs=NULL)
+    try:
+        _ingest(srv, 100)
+        real_refresh = srv.refresh_view
+
+        def refresh_then_advance():
+            view = real_refresh()
+            # New matches land right after every refresh: any SECOND
+            # _serve_view() in the same batch sees staleness > 0 and
+            # refreshes again, splitting the batch across views.
+            srv.engine.ingest(
+                np.array([0], np.int32), np.array([1], np.int32)
+            )
+            return view
+
+        srv.refresh_view = refresh_then_advance
+        out = srv.query_batch([
+            {"leaderboard": (0, 5)},
+            {"players": [1, 2, 3]},
+            {"pairs": [(0, 1), (2, 3)]},
+        ])
+        seqs = {r["view_seq"] for r in out["results"]}
+        assert len(seqs) == 1, f"batch split across views: {seqs}"
+        assert {r["watermark"] for r in out["results"]} == {out["watermark"]}
+        assert out["queries"] == 3
+        assert out["view_seq"] in seqs
+        assert "leaderboard" in out["results"][0]
+        assert "players" in out["results"][1]
+        assert "pairs" in out["results"][2]
+    finally:
+        del srv.refresh_view
+        srv.close()
+
+
+def test_batch_query_over_the_wire_matches_singles(wire):
+    """POST /query returns the same rows the single-lookup GETs serve,
+    index-aligned with the request, wearing the standard envelope."""
+    server, client = wire
+    status, batch = client.batch_query([
+        {"leaderboard": [0, 5]},
+        {"players": [4]},
+        {"pairs": [[2, 5]]},
+    ])
+    assert status == 200
+    assert batch["queries"] == 3 and len(batch["results"]) == 3
+    assert "watermark" in batch and "trace_id" in batch
+    _status, lb = client.get("/leaderboard?offset=0&limit=5")
+    _status, player = client.get("/player/4")
+    _status, h2h = client.get("/h2h?a=2&b=5")
+    assert batch["results"][0]["leaderboard"] == lb["leaderboard"]
+    assert batch["results"][1]["players"] == player["players"]
+    assert batch["results"][2]["pairs"] == h2h["pairs"]
+    # Bad ids reject the whole batch — nothing partially served.
+    status, err = client.batch_query([{"players": [PLAYERS + 50]}])
+    assert status == 400 and "error" in err
+
+
+def test_parse_query_body_validates_shape():
+    specs = parse_query_body(json.dumps({
+        "queries": [
+            {"leaderboard": [0, 10]},
+            {"players": [1, 2], "pairs": [[3, 4]]},
+        ],
+    }).encode("utf-8"))
+    assert specs == [
+        {"leaderboard": (0, 10)},
+        {"players": [1, 2], "pairs": [(3, 4)]},
+    ]
+    for raw in [
+        b"not json",
+        b"[]",
+        b"{}",
+        b'{"queries": []}',
+        b'{"queries": ["x"]}',
+        b'{"queries": [{}]}',
+        b'{"queries": [{"nope": 1}]}',
+        b'{"queries": [{"leaderboard": [0]}]}',
+        b'{"queries": [{"leaderboard": [0, true]}]}',
+        b'{"queries": [{"players": [1.5]}]}',
+        b'{"queries": [{"pairs": [[1]]}]}',
+        b'{"queries": [{"pairs": [1, 2]}]}',
+    ]:
+        with pytest.raises(ProtocolError) as exc:
+            parse_query_body(raw)
+        assert exc.value.status == 400, raw
+    over = {"queries": [{"players": [0]}] * (MAX_BATCH_QUERIES + 1)}
+    with pytest.raises(ProtocolError) as exc:
+        parse_query_body(json.dumps(over).encode("utf-8"))
+    assert exc.value.status == 400
+
+
+def test_wire_client_reuses_one_connection_across_batched_posts(wire):
+    """Satellite (b): batched POSTs ride ONE persistent connection —
+    connections_opened stays at 1 across a mixed GET/POST workload."""
+    server, _client = wire
+    fresh = WireClient(server.host, server.port)
+    try:
+        for _ in range(5):
+            status, resp = fresh.batch_query([{"leaderboard": [0, 3]}])
+            assert status == 200 and resp["queries"] == 1
+            status, _h = fresh.get("/healthz")
+            assert status == 200
+        assert fresh.connections_opened == 1
+    finally:
+        fresh.close()
+
+
+# --- the event-loop front end -----------------------------------------------
+
+
+def test_default_front_end_is_the_event_loop(wire):
+    """Named kill for the audit's
+    event-loop-read-falls-back-to-blocking-silently mutant: the
+    selectors loop is the DEFAULT front end, and the fallback is
+    observable — /healthz reports front_end, and the loop's named
+    thread is live. A silent fallback to thread-per-connection would
+    pass every functional test while quietly reverting the perf
+    tentpole; this test makes it loud."""
+    server, client = wire
+    assert server.front_end == "eventloop"
+    status, health = client.get("/healthz")
+    assert status == 200
+    assert health["front_end"] == "eventloop"
+    names = [t.name for t in threading.enumerate()]
+    assert fastpath.LOOP_THREAD_NAME in names
+    assert any(n.startswith(fastpath.SUBMIT_WORKER_PREFIX) for n in names)
+
+
+def test_threaded_fallback_serves_the_same_protocol():
+    """fastpath_reads=False keeps the legacy ThreadingHTTPServer front
+    end on the SAME request core: every endpoint (including /query and
+    the cache) behaves identically, and /healthz says so."""
+    srv = ArenaServer(num_players=PLAYERS, max_staleness_matches=0, obs=NULL)
+    try:
+        _ingest(srv, 60)
+        with ArenaHTTPServer(srv, fastpath_reads=False) as server:
+            assert server.front_end == "threaded"
+            client = WireClient(server.host, server.port)
+            status, health = client.get("/healthz")
+            assert status == 200 and health["front_end"] == "threaded"
+            status, lb = client.get("/leaderboard?offset=0&limit=5")
+            assert status == 200
+            status, again = client.get("/leaderboard?offset=0&limit=5")
+            assert {k: v for k, v in again.items() if k != "trace_id"} == {
+                k: v for k, v in lb.items() if k != "trace_id"
+            }
+            status, batch = client.batch_query([{"players": [1]}])
+            assert status == 200
+            assert batch["results"][0]["players"][0]["player"] == 1
+            checked, mismatches = server.verify_cache_consistency()
+            assert checked >= 1 and mismatches == []
+            client.close()
+    finally:
+        srv.close()
+
+
+def test_event_loop_answers_malformed_framing_then_closes(wire):
+    """Garbage on the socket gets ONE structured error response and a
+    closed connection — never a hung loop or an unbounded buffer."""
+    server, _client = wire
+    for raw, want in [
+        (b"GARBAGE\r\n\r\n", b"400"),
+        (b"GET /healthz HTTP/9.9\r\n\r\n", b"505"),
+        (b"POST /query HTTP/1.1\r\nContent-Length: 999999999999\r\n\r\n",
+         b"413"),
+        (b"GET /healthz HTTP/1.1\r\nContent-Length: nope\r\n\r\n", b"400"),
+    ]:
+        with socket.create_connection(
+            (server.host, server.port), timeout=10
+        ) as sock:
+            sock.sendall(raw)
+            data = b""
+            while b"\r\n" not in data:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+            status_line = data.split(b"\r\n", 1)[0]
+            assert want in status_line, (raw, status_line)
+            # The connection drains to EOF: closed after one answer.
+            sock.settimeout(10)
+            while True:
+                tail = sock.recv(65536)
+                if not tail:
+                    break
+    # The loop survived all of it.
+    status, _h = _client.get("/healthz")
+    assert status == 200
+
+
+def test_event_loop_serves_pipelined_requests_in_order(wire):
+    """Two requests in one TCP segment come back as two well-formed
+    responses, in order (the _advance loop drains the input buffer)."""
+    server, _client = wire
+    raw = (
+        b"GET /healthz HTTP/1.1\r\n\r\n"
+        b"GET /healthz HTTP/1.1\r\nConnection: close\r\n\r\n"
+    )
+    with socket.create_connection((server.host, server.port), timeout=10) as sock:
+        sock.sendall(raw)
+        data = b""
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    assert data.count(b"HTTP/1.1 200 OK") == 2
+    assert data.count(b'"status": "ok"') == 2
+
+
+# --- the cache object itself ------------------------------------------------
+
+
+def test_response_cache_eviction_prefers_dead_generations():
+    cache = ResponseCache(NULL, capacity=3)
+    cache.put(("a", ()), 1, b"a1")
+    cache.put(("b", ()), 1, b"b1")
+    cache.put(("c", ()), 2, b"c2")  # generation advances to 2
+    assert cache.get(("c", ()), 2) == b"c2"
+    assert cache.get(("a", ()), 2) is None  # dead generation: no hit
+    # At capacity: the next put drops the dead gen-1 entries first.
+    cache.put(("d", ()), 2, b"d2")
+    assert cache.size() == 2  # a1 + b1 evicted, c2 + d2 live
+    assert cache.get(("d", ()), 2) == b"d2"
+    # All-live eviction still bounds the table.
+    cache.put(("e", ()), 2, b"e2")
+    cache.put(("f", ()), 2, b"f2")
+    assert cache.size() == 3
+    cache.close()
+
+
+def test_response_cache_drops_stale_puts_and_closes_terminally():
+    cache = ResponseCache(NULL, capacity=4)
+    cache.put(("k", ()), 5, b"new")
+    cache.put(("k", ()), 3, b"old")  # a slow render from a dead view
+    assert cache.get(("k", ()), 5) == b"new"
+    cache.close()
+    # Deliberate post-close probes: close() is terminal and must stay
+    # safe (refuse fills, answer None), which only a post-close call
+    # can assert.
+    assert cache.size() == 0  # jaxlint: disable=use-after-close
+    cache.put(("k", ()), 6, b"refused")  # jaxlint: disable=use-after-close
+    assert cache.size() == 0  # jaxlint: disable=use-after-close
+    assert cache.get(("k", ()), 6) is None  # jaxlint: disable=use-after-close
+    with pytest.raises(ValueError):
+        ResponseCache(NULL, capacity=0)
+
+
+def test_cache_key_canonicalizes_param_order():
+    assert cache_key("leaderboard", {"offset": 0, "limit": 10}) == cache_key(
+        "leaderboard", {"limit": 10, "offset": 0}
+    )
+
+
+# --- concurrency: 8 readers vs live ingest ----------------------------------
+
+
+def test_eight_readers_hammer_the_cache_while_ingest_advances(wire):
+    """Satellite: 8 reader threads over real HTTP against a zero
+    staleness bound while the main thread ingests — every response
+    well-formed, per-reader watermarks monotone (a cache serving dead
+    bytes regresses them), and the consistency gate clean at the end."""
+    server, _client = wire
+    srv = server.server
+    stop = threading.Event()
+    errors = []
+    rounds = [0] * 8
+
+    def reader(rid):
+        client = WireClient(server.host, server.port)
+        last = -1
+        try:
+            while not stop.is_set():
+                for path in (
+                    "/leaderboard?offset=0&limit=10",
+                    f"/player/{rid}",
+                    f"/h2h?a={rid}&b={(rid + 1) % PLAYERS}",
+                ):
+                    status, resp = client.get(path)
+                    if status != 200:
+                        errors.append((rid, path, status, resp))
+                        return
+                    if resp["watermark"] < last:
+                        errors.append((rid, "watermark regressed",
+                                       resp["watermark"], last))
+                        return
+                    last = resp["watermark"]
+                rounds[rid] += 1
+        except Exception as exc:  # noqa: BLE001 — surfaced via errors
+            errors.append((rid, "exception", repr(exc)))
+        finally:
+            client.close()
+
+    threads = [
+        threading.Thread(target=reader, args=(i,), daemon=True)
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for i in range(12):
+        _ingest(srv, 20, seed=1000 + i)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    assert not errors, errors[:5]
+    assert all(r > 0 for r in rounds), rounds
+    checked, mismatches = server.verify_cache_consistency()
+    assert mismatches == []
+    reg = srv.obs.registry
+    assert reg.counter_sum("arena_wire_cache_hits_total") > 0
+    assert reg.counter_sum("arena_wire_cache_misses_total") > 0
