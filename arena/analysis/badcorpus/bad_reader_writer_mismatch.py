@@ -1,0 +1,19 @@
+"""jaxlint corpus: a reader depends on a field no writer produces.
+
+`parse_rows` is contracted to `corpus-wire@v1` (sidecar fields
+{status, rows}) but requires `row_count` from the payload — a field
+outside the recorded shape, so no contracted writer is obligated to
+send it. The reader works against today's writer by luck and breaks
+the day the writer is regenerated from the contract.
+Rule: reader-writer-schema-mismatch.
+"""
+
+
+def parse_rows(payload):  # schema: corpus-wire@v1
+    if payload.get("status") != "ok":
+        raise ValueError("bad payload status")
+    expected = payload.get("row_count")
+    rows = payload.get("rows")
+    if rows is None or len(rows) != expected:
+        raise ValueError("row count mismatch")
+    return rows

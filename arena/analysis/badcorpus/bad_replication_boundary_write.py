@@ -1,0 +1,29 @@
+"""jaxlint corpus: replicated state written outside the apply closure.
+
+`apply` is the `# deterministic; mutates:` apply root: its declared
+write set (`ratings`, `matches_applied`) IS the replicated state a
+log-replaying replica reconstructs, and `_bump` is inside the apply
+call closure, so its writes replay fine. `recalibrate` is NOT in that
+closure — an operator convenience that rescales ratings in place. A
+replica replaying the match log never executes it, so the moment it
+runs, primary and replica disagree forever after.
+Rule: replication-boundary-write.
+"""
+
+
+class ReplicaRatings:
+    def __init__(self):
+        self.ratings = {}
+        self.matches_applied = 0
+
+    def apply(self, batch):  # deterministic; mutates: ratings, matches_applied
+        for player, delta in batch:
+            self._bump(player, delta)
+
+    def _bump(self, player, delta):
+        self.ratings[player] = self.ratings.get(player, 0.0) + delta
+        self.matches_applied += 1
+
+    def recalibrate(self, scale):
+        for player in list(self.ratings):
+            self.ratings[player] = self.ratings[player] * scale

@@ -1,0 +1,20 @@
+"""jaxlint corpus: a versioned serialized format drifts silently.
+
+`write_manifest` is contracted to `corpus-manifest@v1`, whose sidecar
+(`schemas/corpus-manifest.json`) records fields {magic, version,
+num_rows} behind the `CORPUS_MANIFEST_VERSION` constant. The writer
+now also emits `row_digest` — but the constant still says 1, so every
+deployed reader of v1 manifests meets a shape it never agreed to.
+Rule: schema-drift-without-version-bump.
+"""
+
+CORPUS_MANIFEST_VERSION = 1
+
+
+def write_manifest(store):  # schema: corpus-manifest@v1
+    return {
+        "magic": "CORPUS",
+        "version": CORPUS_MANIFEST_VERSION,
+        "num_rows": store.num_rows,
+        "row_digest": store.digest(),
+    }

@@ -1,0 +1,17 @@
+"""jaxlint corpus: an unversioned wire format grows an undeclared key.
+
+`render_rows` is contracted to `corpus-wire@v1`, whose sidecar
+(`schemas/corpus-wire.json`) declares fields {status, rows}. The
+render also writes `debug_hint` — additive wire evolution is fine,
+but only THROUGH the sidecar, so readers learn the field exists from
+a reviewed diff instead of from production traffic.
+Rule: undeclared-serialized-field.
+"""
+
+
+def render_rows(rows):  # schema: corpus-wire@v1
+    return {
+        "status": "ok",
+        "rows": list(rows),
+        "debug_hint": "drop me before shipping",
+    }

@@ -79,6 +79,7 @@ import io
 import json
 import pathlib
 import sys
+import threading
 import tokenize
 
 from arena.analysis import project as project_mod
@@ -254,8 +255,7 @@ class ModuleContext:
     def __init__(self, path: str, source: str):
         self.path = path
         self.source = source
-        self.tree = ast.parse(source, filename=path)
-        raw_suppressions, comments = _comment_tables(source)
+        self.tree, raw_suppressions, comments = _parsed(path, source)
         self.suppressions = _expand_suppressions(self.tree, raw_suppressions)
         self.symbols = project_mod.module_symbols(path, self.tree, comments)
         self.project = None
@@ -309,6 +309,44 @@ class ModuleContext:
 
     def finding(self, node, rule_name, message) -> Finding:
         return Finding(self.path, node.lineno, node.col_offset, rule_name, message)
+
+
+# Content-keyed parse memo. The selfcheck suite, the corpus tests,
+# and `--gate` all call `lint_paths`/`lint_source` repeatedly in one
+# process, and every call re-parsed and re-tokenized the same
+# unchanged sources. One entry caches the (tree, raw suppression
+# table, comment table) triple per (path, source); no pass mutates a
+# parsed tree or either table, so sharing them across ModuleContext
+# instances is safe. Keyed by source HASH with the full source kept in
+# the entry for an equality check (a hash collision must miss, never
+# serve the wrong tree). Bounded by wholesale reset — the working set
+# is one repo's files; an eviction policy would be ceremony.
+_PARSE_CACHE: dict = {}
+_PARSE_CACHE_LOCK = threading.Lock()
+_PARSE_CACHE_MAX = 1024
+
+
+def _parsed(path: str, source: str):
+    key = (path, hash(source))
+    with _PARSE_CACHE_LOCK:
+        hit = _PARSE_CACHE.get(key)
+        if hit is not None and hit[0] == source:
+            return hit[1]
+    tree = ast.parse(source, filename=path)
+    raw_suppressions, comments = _comment_tables(source)
+    entry = (tree, raw_suppressions, comments)
+    with _PARSE_CACHE_LOCK:
+        if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[key] = (source, entry)
+    return entry
+
+
+def clear_parse_cache():
+    """Drop every memoized parse (tests use this to compare a cold run
+    against a warm one bit-for-bit)."""
+    with _PARSE_CACHE_LOCK:
+        _PARSE_CACHE.clear()
 
 
 def _comment_tables(source: str):
@@ -1161,6 +1199,16 @@ def main(argv=None) -> int:
         "flag-day fixes.",
     )
     parser.add_argument(
+        "--gate", action="store_true",
+        help="one-shot CI mode: the FULL registry over the default "
+        "targets, findings printed in the human format AND a SARIF "
+        "2.1.0 document written to jaxlint.sarif in the current "
+        "directory (next to the exit code, for annotation tooling). "
+        "Exit-code semantics unchanged. Combining --gate with explicit "
+        "paths, --rules/--disable, or --baseline is an error (rc 2) — "
+        "the gate IS the fixed configuration.",
+    )
+    parser.add_argument(
         "--jobs", type=int, default=1, metavar="N",
         help="fan the per-file rule pass over N threads after the "
         "serial symbol-table pass; findings are bit-identical to the "
@@ -1171,6 +1219,17 @@ def main(argv=None) -> int:
         for r in RULES.values():
             print(f"{r.name} [{r.severity}]: {r.summary}")
         return 0
+    if args.gate and (
+        args.paths or args.rules is not None or args.disable is not None
+        or args.baseline is not None
+    ):
+        print(
+            "jaxlint: --gate fixes the configuration (full registry, "
+            "default targets); drop the extra paths/--rules/--disable/"
+            "--baseline",
+            file=sys.stderr,
+        )
+        return 2
     selected = None
     if args.rules is not None or args.disable is not None:
         selected = (
@@ -1193,7 +1252,7 @@ def main(argv=None) -> int:
     try:
         findings = lint_paths(
             targets,
-            keep_suppressed=(args.format in ("json", "sarif")),
+            keep_suppressed=(args.format in ("json", "sarif") or args.gate),
             rules=selected,
             jobs=args.jobs,
         )
@@ -1264,6 +1323,10 @@ def main(argv=None) -> int:
             )
             findings = [f for f in findings if f.suppressed]
     live = [f for f in findings if not f.suppressed]
+    if args.gate:
+        gate_path = pathlib.Path("jaxlint.sarif")
+        gate_path.write_text(_sarif_report(findings) + "\n", encoding="utf-8")
+        print(f"jaxlint: SARIF written -> {gate_path}", file=sys.stderr)
     if args.format == "json":
         for f in findings:
             print(_json_line(f))
@@ -1288,6 +1351,7 @@ from arena.analysis import concurrency as _concurrency  # noqa: E402,F401
 from arena.analysis import absint as _absint  # noqa: E402,F401
 from arena.analysis import lifecycle as _lifecycle  # noqa: E402,F401
 from arena.analysis import effects as _effects  # noqa: E402,F401
+from arena.analysis import schema as _schema  # noqa: E402,F401
 
 
 if __name__ == "__main__":

@@ -112,6 +112,26 @@ MUTATES_RE = re.compile(
 )
 
 
+# `schema: <name>@v<N>` marks a def/class as a writer or reader of the
+# named serialized format (snapshot manifest, wire envelope, spill
+# record, ...). The recorded shape lives in a checked-in sidecar JSON
+# under arena/analysis/schemas/ (see schema.py); the clause coexists
+# with the other contract clauses on one comment
+# (`# pure-render(view); schema: wire-player-row@v1`).
+SCHEMA_RE = re.compile(
+    r"(?:^|;)\s*schema:\s*([A-Za-z][A-Za-z0-9_.-]*)@v(\d+)\s*(?:$|;)"
+)
+
+
+def parse_schema(comment_text):
+    """(name, version) from one comment's `schema:` clause, or None.
+    Malformed clauses are simply not matched — never a parse error."""
+    match = SCHEMA_RE.search(comment_text)
+    if match is None:
+        return None
+    return match.group(1), int(match.group(2))
+
+
 def parse_contract(comment_text):
     """A contract record parsed from one comment's text, or None when
     the comment declares nothing. The record is a dict with keys
@@ -225,6 +245,7 @@ class ModuleSymbols:
     lock_edges: list = dataclasses.field(default_factory=list)  # (outer, inner, line, col)
     lock_calls: list = dataclasses.field(default_factory=list)  # (held, callee, line, col)
     contracts: dict = dataclasses.field(default_factory=dict)  # qualname -> contract
+    schemas: dict = dataclasses.field(default_factory=dict)  # qualname -> (name, version)
 
 
 # --- collection helpers ----------------------------------------------------
@@ -492,6 +513,9 @@ def module_symbols(path: str, tree, comments: dict) -> ModuleSymbols:
             pairs, terminal = parse_protocols(comments.get(ln, ""))
             cls.protocol_pairs.extend(pairs)
             cls.protocol_terminal |= terminal
+            schema = parse_schema(comments.get(ln, ""))
+            if schema is not None:
+                sym.schemas[node.name] = schema
         for sub in ast.walk(node):
             if isinstance(sub, ast.Call):
                 fname = dotted(sub.func)
@@ -538,6 +562,9 @@ def module_symbols(path: str, tree, comments: dict) -> ModuleSymbols:
             contract = parse_contract(comments.get(ln, ""))
             if contract is not None:
                 sym.contracts[qualname] = contract
+            schema = parse_schema(comments.get(ln, ""))
+            if schema is not None:
+                sym.schemas[qualname] = schema
         resolver = make_lock_resolver(sym, cls)
         held0 = ()
         if cls is not None and fn_node.name.endswith(LOCKED_SUFFIX):

@@ -1,0 +1,499 @@
+"""Serialized-schema contract analyzer (jaxlint v6).
+
+A `# schema: <name>@v<N>` clause on a def/class header (see
+`arena.analysis.project.parse_schema`) declares the function a writer
+or reader of the named serialized format — the snapshot manifest and
+`arrays.bin` layout, the wire envelope and per-endpoint response
+renders, the front door's `applied_log` replication records, the spill
+records. The format's recorded shape lives in a checked-in sidecar
+JSON (``arena/analysis/schemas/<name>.json``, or a ``schemas/``
+directory next to the module for corpus fixtures), so changing a
+serialized shape is a reviewable diff, not an archaeology project.
+
+Per contracted function the analyzer extracts concrete shape FACTS
+from the code — dict-literal keys, string-keyed subscript stores and
+loads, ``.get("key")`` reads, membership/iteration tuples of string
+literals, ``("name", value)`` record tags, ordered ``[("name", arr),
+...]`` array tables, and np dtype constructors resolved through the
+v3 abstract-value machinery — and enforces three shape rules:
+
+- ``schema-drift-without-version-bump``: a VERSIONED format (its
+  sidecar names a ``version_constant``) produces a key the sidecar
+  does not record, reorders the recorded array table, or changes a
+  recorded dtype, and the named module version constant was not
+  bumped past the recorded version. Replicas parse these bytes; a
+  silent shape change is a fleet-wide parse error.
+- ``undeclared-serialized-field``: an UNVERSIONED format (wire
+  responses — additive evolution, no version constant) produces a
+  key its sidecar does not declare. Add the field to the sidecar so
+  readers know it exists, or stop writing it.
+- ``reader-writer-schema-mismatch``: any contracted function CONSUMES
+  a key the sidecar does not declare — a reader (``restore``,
+  ``WireClient`` parses, spill resubmission) depending on a field no
+  writer is contracted to produce.
+
+The fourth rule cashes in the v5 effect-summary machinery for ROADMAP
+item 2's bit-exact-replay precondition:
+
+- ``replication-boundary-write``: for every class whose methods carry
+  `# deterministic; mutates:` contracts (the apply roots), the union
+  of their declared write sets is REPLICATED STATE. Any method of the
+  class outside the apply roots' transitive call closure (computed to
+  a fixpoint over the call edges the symbol table resolves) whose own
+  raw effect summary writes one of those attributes is a finding: a
+  replica replaying the log in sequence order would never execute
+  that write, so the write forks primary and replica state.
+  Admission-side attributes a class legitimately writes on its intake
+  path are exempted in ``schemas/replication-boundary.json`` (keyed
+  by class name, each with a recorded "why"); lifecycle dunders and
+  v4 `# protocol:` methods are exempt by construction.
+
+No-claim semantics throughout: unresolvable calls contribute no
+closure edges, unextractable expressions contribute no facts. Facts
+are one-sided — a contracted function touching only a few declared
+keys is fine (per-function facts are subsets of the format); only
+NEW produced keys, NEW consumed keys, extracted-order mismatches, and
+dtype contradictions are findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+
+from arena.analysis import absint, effects
+from arena.analysis.jaxlint import rule
+from arena.analysis.project import dotted
+
+RULE_DRIFT = "schema-drift-without-version-bump"
+RULE_MISMATCH = "reader-writer-schema-mismatch"
+RULE_UNDECLARED = "undeclared-serialized-field"
+RULE_BOUNDARY = "replication-boundary-write"
+
+_RULE_NAMES = (RULE_DRIFT, RULE_MISMATCH, RULE_UNDECLARED, RULE_BOUNDARY)
+
+# The checked-in recorded shapes. A `schemas/` directory NEXT TO the
+# contracted module wins over this one, so corpus fixtures carry their
+# own sidecars without polluting the real registry.
+SCHEMAS_DIR = pathlib.Path(__file__).resolve().parent / "schemas"
+
+# Methods never reachable from the apply path by design: constructors
+# and context-manager plumbing initialize or tear down the state the
+# apply path replays ONTO; they are not part of the replayed history.
+_LIFECYCLE_METHODS = frozenset({"__init__", "__enter__", "__exit__", "__del__"})
+
+
+# --- sidecar loading -------------------------------------------------------
+
+
+def _sidecar_path(module_path: str, name: str):
+    local = pathlib.Path(module_path).resolve().parent / "schemas" / f"{name}.json"
+    if local.exists():
+        return local
+    global_ = SCHEMAS_DIR / f"{name}.json"
+    if global_.exists():
+        return global_
+    return None
+
+
+def _load_sidecar(module_path: str, name: str):
+    """(record dict, path) for the schema's sidecar, or (None, None)
+    when no sidecar exists. Unreadable JSON is treated as missing —
+    the drift rule reports it either way."""
+    path = _sidecar_path(module_path, name)
+    if path is None:
+        return None, None
+    try:
+        return json.loads(path.read_text(encoding="utf-8")), path
+    except (OSError, ValueError):
+        return None, path
+
+
+def _load_exemptions(module_path: str) -> dict:
+    """class name -> frozenset of exempt attrs from the
+    replication-boundary sidecar (empty when absent)."""
+    record, _path = _load_sidecar(module_path, "replication-boundary")
+    out = {}
+    if record is None:
+        return out
+    for cls_name, entry in record.get("exempt", {}).items():
+        out[cls_name] = frozenset(entry.get("attrs", ()))
+    return out
+
+
+# --- fact extraction -------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Facts:
+    """Shape facts extracted from one contracted function."""
+
+    produced: frozenset  # keys this code writes into the format
+    consumed: frozenset  # keys this code requires from the format
+    arrays: tuple  # ordered array-table names, () when none extracted
+    dtypes: dict  # key -> dtype name, for resolvable constructors
+
+
+def _const_str(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _value_dtype(value):
+    """dtype name a serialized value is constructed with, or None —
+    `np.asarray(x, np.float32)`, `zeros(n, dtype="int32")`,
+    `x.astype(np.int32)` all resolve via the v3 dtype lattice."""
+    if not isinstance(value, ast.Call):
+        return None
+    for kw in value.keywords:
+        if kw.arg == "dtype":
+            return absint._resolve_dtype(kw.value)
+    fname = dotted(value.func)
+    tail = fname.split(".")[-1] if fname else ""
+    if tail == "astype" and value.args:
+        return absint._resolve_dtype(value.args[0])
+    if tail in ("asarray", "array", "zeros", "ones", "full", "empty"):
+        if len(value.args) >= 2:
+            return absint._resolve_dtype(value.args[1])
+    return None
+
+
+def _all_str_elts(node):
+    """The element strings when EVERY element of a tuple/list/set
+    literal is a string constant, else None."""
+    elts = getattr(node, "elts", None)
+    if not elts:
+        return None
+    out = [_const_str(e) for e in elts]
+    if any(s is None for s in out):
+        return None
+    return out
+
+
+def _tuple_first_strs(node):
+    """Ordered first-element names when a list literal is a table of
+    >= 2 tuples each tagged by a leading string constant — the
+    `[("keys", arr), ("pos", arr), ...]` array-table idiom."""
+    if not isinstance(node, ast.List) or len(node.elts) < 2:
+        return None
+    names = []
+    for elt in node.elts:
+        if not (isinstance(elt, ast.Tuple) and len(elt.elts) >= 2):
+            return None
+        name = _const_str(elt.elts[0])
+        if name is None:
+            return None
+        names.append(name)
+    return tuple(names)
+
+
+def _extract_facts(decl_node) -> _Facts:
+    """One walk over the contracted def/class body. Reader-shaped
+    string-literal collections (for/comprehension iteration tuples,
+    membership-test tuples, required-set literals) are CONSUMED keys
+    and excluded from the produced-tag extraction."""
+    produced, consumed = set(), set()
+    arrays = ()
+    dtypes = {}
+    reader_collections = set()  # node ids routed to `consumed`
+    for node in ast.walk(decl_node):
+        it = None
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.comprehension)):
+            it = node.iter
+        elif isinstance(node, ast.Compare):
+            for cmp_node in node.comparators:
+                if _all_str_elts(cmp_node) is not None:
+                    reader_collections.add(id(cmp_node))
+        if it is not None and _all_str_elts(it) is not None:
+            reader_collections.add(id(it))
+    for node in ast.walk(decl_node):
+        if isinstance(node, ast.Dict):
+            for key_node, value in zip(node.keys, node.values):
+                key = _const_str(key_node)
+                if key is None:
+                    continue
+                produced.add(key)
+                found = _value_dtype(value)
+                if found is not None:
+                    dtypes[key] = found
+        elif isinstance(node, ast.Subscript):
+            key = _const_str(node.slice)
+            if key is None:
+                continue
+            if isinstance(node.ctx, ast.Store):
+                produced.add(key)
+            else:  # Load or Del: the key must exist to be read/removed
+                consumed.add(key)
+        elif isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname and fname.split(".")[-1] == "get" and node.args:
+                key = _const_str(node.args[0])
+                if key is not None:
+                    consumed.add(key)
+        elif isinstance(node, ast.Set):
+            keys = _all_str_elts(node)
+            if keys is not None:
+                consumed.update(keys)
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            if id(node) in reader_collections:
+                consumed.update(_all_str_elts(node))
+                continue
+            order = _tuple_first_strs(node)
+            if order is not None and len(order) > len(arrays):
+                arrays = order
+            if (isinstance(node, ast.Tuple) and len(node.elts) >= 2
+                    and isinstance(node.ctx, ast.Load)):
+                key = _const_str(node.elts[0])
+                if key is not None:
+                    produced.add(key)
+                    found = _value_dtype(node.elts[1])
+                    if found is not None:
+                        dtypes[key] = found
+    return _Facts(frozenset(produced), frozenset(consumed), arrays, dtypes)
+
+
+# --- version-bump detection ------------------------------------------------
+
+
+def _module_int_constant(tree, name):
+    """Module-level `NAME = <int literal>` binding, or None."""
+    for node in tree.body:
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = (node.target,)
+        else:
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, int)
+                and not isinstance(value.value, bool)):
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == name:
+                return value.value
+    return None
+
+
+def _version_bumped(tree, sidecar, annotated_version) -> bool:
+    """Whether the writer's module already bumped past the recorded
+    version: the sidecar's named version constant when the module
+    binds it, the `@vN` annotation otherwise."""
+    recorded = int(sidecar.get("version", 0))
+    const_name = sidecar.get("version_constant")
+    if const_name is not None:
+        found = _module_int_constant(tree, const_name)
+        if found is not None:
+            return found > recorded  # a bump is strictly-greater, never equal
+    return annotated_version > recorded
+
+
+# --- the module pass -------------------------------------------------------
+
+
+def _resolve_decl(sym, qualname):
+    """The ast node a schema contract is attached to: a module
+    function, a `Cls.method`, or a class header."""
+    if qualname in sym.functions:
+        return sym.functions[qualname]
+    if qualname in sym.classes:
+        return sym.classes[qualname].node
+    if "." in qualname:
+        cls_name, mname = qualname.split(".", 1)
+        cls = sym.classes.get(cls_name)
+        if cls is not None:
+            return cls.methods.get(mname)
+    return None
+
+
+def _schema_pass(ctx, findings):
+    sym = ctx.symbols
+    for qualname in sorted(sym.schemas):
+        name, version = sym.schemas[qualname]
+        node = _resolve_decl(sym, qualname)
+        if node is None:
+            continue
+        sidecar, sidecar_path = _load_sidecar(sym.path, name)
+        if sidecar is None:
+            where = sidecar_path or (SCHEMAS_DIR / f"{name}.json")
+            findings[RULE_DRIFT].append(ctx.finding(
+                node, RULE_DRIFT,
+                f"`{qualname}` declares `schema: {name}@v{version}` but no "
+                f"recorded shape exists — check in the sidecar `{where}`",
+            ))
+            continue
+        facts = _extract_facts(node)
+        declared = (frozenset(sidecar.get("fields", ()))
+                    | frozenset(sidecar.get("arrays", ())))
+        new_produced = sorted(facts.produced - declared)
+        recorded_arrays = tuple(sidecar.get("arrays", ()))
+        order_drift = bool(facts.arrays and recorded_arrays
+                           and facts.arrays != recorded_arrays)
+        recorded_dtypes = sidecar.get("dtypes", {})
+        dtype_drift = sorted(
+            f"{key}: {recorded_dtypes[key]} -> {found}"
+            for key, found in facts.dtypes.items()
+            if key in recorded_dtypes and recorded_dtypes[key] != found
+        )
+        if "version_constant" in sidecar:
+            drifted = []
+            if new_produced:
+                drifted.append("new field(s) " + ", ".join(new_produced))
+            if order_drift:
+                drifted.append(
+                    "array order " + "/".join(facts.arrays)
+                    + " != recorded " + "/".join(recorded_arrays)
+                )
+            if dtype_drift:
+                drifted.append("dtype " + "; ".join(dtype_drift))
+            if drifted and not _version_bumped(ctx.tree, sidecar, version):
+                findings[RULE_DRIFT].append(ctx.finding(
+                    node, RULE_DRIFT,
+                    f"`{qualname}` drifts `{name}` ({'; '.join(drifted)}) "
+                    f"without bumping `{sidecar['version_constant']}` past "
+                    f"v{sidecar.get('version', 0)} — replicas parse these "
+                    f"bytes; bump the version and update the sidecar",
+                ))
+        else:
+            for key in new_produced:
+                findings[RULE_UNDECLARED].append(ctx.finding(
+                    node, RULE_UNDECLARED,
+                    f"`{qualname}` writes field `{key}` not declared by "
+                    f"schema `{name}` — add it to the sidecar so readers "
+                    f"know it exists, or stop writing it",
+                ))
+        undeclared_reads = sorted(facts.consumed - declared)
+        if undeclared_reads:
+            findings[RULE_MISMATCH].append(ctx.finding(
+                node, RULE_MISMATCH,
+                f"`{qualname}` consumes field(s) "
+                f"{', '.join(undeclared_reads)} that schema `{name}` does "
+                f"not declare — no contracted writer produces them",
+            ))
+
+
+def _replication_pass(ctx, out):
+    sym = ctx.symbols
+    project = ctx.project
+    exempt = _load_exemptions(sym.path)
+    mods = (list(project.modules.values()) if project is not None
+            else [sym])
+    nodes = {}
+    for mod in mods:
+        for qualname, fn_node, cls_name in effects._iter_module_functions(mod):
+            nodes[f"{mod.name}::{qualname}"] = (mod, cls_name, fn_node)
+    summaries = {}
+
+    def raw(key):
+        cached = summaries.get(key)
+        if cached is None:
+            mod, cls_name, fn_node = nodes[key]
+            methods = (set(mod.classes[cls_name].methods)
+                       if cls_name is not None else frozenset())
+            summary, callee_names = effects._raw_summary(fn_node, key, methods)
+            edges = set()
+            for fname in callee_names:
+                target = effects._resolve_callee(mod, cls_name, fname, project)
+                if target is not None and target != key and target in nodes:
+                    edges.add(target)
+            cached = (summary, frozenset(edges))
+            summaries[key] = cached
+        return cached
+
+    for cls in sym.classes.values():
+        roots, protected = [], set()
+        for mname in cls.methods:
+            contract = sym.contracts.get(f"{cls.name}.{mname}")
+            if (contract is not None and contract["deterministic"]
+                    and contract["mutates"]):
+                roots.append(f"{sym.name}::{cls.name}.{mname}")
+                protected |= set(contract["mutates"])
+        protected -= exempt.get(cls.name, frozenset())
+        if not roots or not protected:
+            continue
+        closure = set(roots)
+        frontier = list(roots)
+        while frontier:  # transitive apply closure, to fixpoint over call edges
+            nxt = []
+            for key in frontier:
+                for callee in raw(key)[1]:
+                    if callee not in closure:
+                        closure.add(callee)
+                        nxt.append(callee)
+            frontier = nxt
+        skip = _LIFECYCLE_METHODS | cls.protocol_methods()
+        for mname in sorted(cls.methods):
+            key = f"{sym.name}::{cls.name}.{mname}"
+            if key in closure or mname in skip:
+                continue
+            summary, _edges = raw(key)
+            bad = sorted(set(summary.self_writes) & protected)
+            if bad:
+                out.append(ctx.finding(
+                    cls.methods[mname], RULE_BOUNDARY,
+                    f"`{cls.name}.{mname}` writes replicated state "
+                    f"({', '.join(bad)}) outside the `# deterministic` "
+                    f"apply closure — a replica replaying the log never "
+                    f"executes this write, forking primary and replica; "
+                    f"route it through the apply path or exempt the attr "
+                    f"in schemas/replication-boundary.json with a reason",
+                ))
+
+
+def _analysis(ctx):
+    cached = getattr(ctx, "_schema_findings", None)
+    if cached is None:
+        cached = {name: [] for name in _RULE_NAMES}
+        _schema_pass(ctx, cached)
+        _replication_pass(ctx, cached[RULE_BOUNDARY])
+        ctx._schema_findings = cached
+    return cached
+
+
+# --- the four v6 rules -----------------------------------------------------
+
+
+@rule(
+    RULE_DRIFT,
+    "a versioned serialized format (`# schema: name@vN` with a sidecar "
+    "version constant) gains a field, reorders its array table, or changes "
+    "a dtype without bumping the named version constant",
+    severity="error",
+)
+def _check_schema_drift(ctx):
+    yield from _analysis(ctx)[RULE_DRIFT]
+
+
+@rule(
+    RULE_MISMATCH,
+    "a `# schema:`-contracted reader consumes a field its schema sidecar "
+    "does not declare — no contracted writer produces it",
+    severity="error",
+)
+def _check_reader_writer_mismatch(ctx):
+    yield from _analysis(ctx)[RULE_MISMATCH]
+
+
+@rule(
+    RULE_UNDECLARED,
+    "a `# schema:`-contracted writer of an unversioned wire format emits a "
+    "field its sidecar does not declare — declare it or stop writing it",
+    severity="error",
+)
+def _check_undeclared_field(ctx):
+    yield from _analysis(ctx)[RULE_UNDECLARED]
+
+
+@rule(
+    RULE_BOUNDARY,
+    "a method outside the `# deterministic` apply closure writes an "
+    "attribute in the apply path's `mutates:` closure — log replay would "
+    "never execute the write, forking replica state from the primary",
+    severity="error",
+)
+def _check_replication_boundary(ctx):
+    yield from _analysis(ctx)[RULE_BOUNDARY]

@@ -298,7 +298,7 @@ class ArenaEngine:  # protocol: shutdown
         with self._view_lock:
             return np.array(self.ratings, copy=True), self.matches_applied
 
-    def adopt_state(self, ratings, store):
+    def adopt_state(self, ratings, store):  # deterministic; mutates: ratings, _store, matches_applied
         """Install restored state (the serving layer's snapshot hook):
         ratings vector + match store, replacing the fresh-engine
         empties. Refuses on an engine that has already ingested —
@@ -380,7 +380,7 @@ class ArenaEngine:  # protocol: shutdown
 
     # --- the overlapped (async) ingest path --------------------------
 
-    def _pack_for_pipeline(self, w, l):
+    def _pack_for_pipeline(self, w, l):  # deterministic; mutates: _store, _staging
         """Packer-thread half of one async batch: merge into the store,
         fill the next staging slot. Returns None for an empty batch
         (nothing to dispatch). block=True: if both slots of the bucket

@@ -82,7 +82,7 @@ def cache_key(endpoint, params):
     return (endpoint, tuple(sorted(params.items())))
 
 
-def render_head(payload, watermark):
+def render_head(payload, watermark):  # schema: wire-envelope@v1
     """Render a response payload into a cacheable byte head: the full
     JSON envelope minus the trailing ``"trace_id"`` pair and the
     closing brace. `make_response` strips any payload-supplied
@@ -101,7 +101,8 @@ def complete_response(head, trace_id):
     return head + b', "trace_id": ' + str(trace_id).encode("ascii") + b"}"
 
 
-def render_query_payload(srv, view, stale, endpoint, params, staleness=None):
+def render_query_payload(srv, view, stale, endpoint, params,
+                         staleness=None):  # schema: wire-read-params@v1
     """Map one cacheable GET endpoint's parsed params onto a
     `_query_parts` render against an already-chosen view. `staleness`
     defaults to the view-stable distance (ingested-at-clone minus

@@ -388,7 +388,7 @@ class FrontDoor:
                 self._applying = False
                 self._cv.notify_all()
 
-    def _apply(self, popped):  # deterministic; mutates: summaries_applied, applied_batches, applied_matches, applied_log
+    def _apply(self, popped):  # deterministic; mutates: summaries_applied, applied_batches, applied_matches, applied_log; schema: applied-log-record@v1
         kind, payload = popped
         obs = self._obs()
         if kind == "summary":
@@ -486,7 +486,7 @@ class FrontDoor:
                 self._cv.wait(_WAIT_S)
         self._eng.flush()
 
-    def close(self, spill=False):
+    def close(self, spill=False):  # schema: frontdoor-spill@v1
         """Stop the front door and join the merge worker.
 
         Default: drain everything contiguously deliverable, then stop
@@ -541,7 +541,7 @@ class FrontDoor:
             self._eng.flush()
         return spilled
 
-    def resubmit_spilled(self, spilled):
+    def resubmit_spilled(self, spilled):  # schema: frontdoor-spill@v1
         """Re-admit a `close(spill=True)` extraction in deterministic
         order: summary segments first (as INDIVIDUAL batches — the
         restart restores the granularity pending coalescing would have

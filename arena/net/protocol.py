@@ -129,7 +129,7 @@ def parse_path(method, path):
     return endpoint, parsed
 
 
-def parse_submit_body(raw):
+def parse_submit_body(raw):  # schema: wire-submit-request@v1
     """Validate a submit body into (winners, losers, producer).
 
     The body is ``{"winners": [ints], "losers": [ints],
@@ -164,7 +164,7 @@ def _plain_int(value):
     return isinstance(value, int) and not isinstance(value, bool)
 
 
-def parse_query_body(raw):
+def parse_query_body(raw):  # schema: wire-query-request@v1
     """Validate a batched read body into a list of query specs.
 
     The body is ``{"queries": [{"leaderboard": [offset, limit]?,
@@ -239,7 +239,7 @@ def parse_query_body(raw):
     return specs
 
 
-def make_response(payload, *, watermark, trace_id):
+def make_response(payload, *, watermark, trace_id):  # schema: wire-envelope@v1
     """The response envelope: the payload dict plus the staleness
     watermark and the request's trace id, side by side in EVERY JSON
     response (the wire contract the tier-1 wire tests pin; a payload's
@@ -315,14 +315,14 @@ class WireClient:
         status, payload, _headers = self._request("POST", path, body=doc)
         return status, payload
 
-    def batch_query(self, queries):
+    def batch_query(self, queries):  # schema: wire-query-request@v1
         """POST many lookups as ONE /query request on the persistent
         connection. `queries` is a list of spec dicts (the
         `parse_query_body` schema); the response's "results" list is
         index-aligned with it, every entry answered from one view."""
         return self.post("/query", {"queries": list(queries)})
 
-    def submit(self, winners, losers, producer="local"):
+    def submit(self, winners, losers, producer="local"):  # schema: wire-submit-request@v1
         """POST one batch to /submit (ids coerced to plain ints)."""
         return self.post("/submit", {
             "winners": [int(i) for i in np.asarray(winners).tolist()],

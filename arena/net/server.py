@@ -109,12 +109,7 @@ def _dispatch(wire, endpoint, params, body_raw):
     payload None means the Prometheus text body."""
     srv = wire.server
     if endpoint == "healthz":
-        return 200, {
-            "status": "ok",
-            "front_end": wire.front_end,
-            "players": srv.engine.num_players,
-            "matches_ingested": srv.engine.matches_ingested,
-        }
+        return 200, _healthz_payload(wire)
     if endpoint == "stats":
         return 200, None  # body rendered from the registry
     if endpoint == "leaderboard":
@@ -140,7 +135,17 @@ def _dispatch(wire, endpoint, params, body_raw):
     raise protocol.ProtocolError(404, f"no such endpoint: {endpoint!r}")
 
 
-def _trace_payload(wire, trace_id):
+def _healthz_payload(wire):  # schema: wire-healthz@v1
+    srv = wire.server
+    return {
+        "status": "ok",
+        "front_end": wire.front_end,
+        "players": srv.engine.num_players,
+        "matches_ingested": srv.engine.matches_ingested,
+    }
+
+
+def _trace_payload(wire, trace_id):  # schema: wire-debug-trace@v1
     """Resolve one trace id (a response's `trace_id`, an SLO
     alert's exemplar) into its recorded spans. 404 when the ring
     kept nothing for it — evicted or never allocated. The payload
@@ -167,7 +172,7 @@ def _trace_payload(wire, trace_id):
     }
 
 
-def _submit(wire, body_raw):
+def _submit(wire, body_raw):  # schema: wire-submit-response@v1
     frontdoor = wire.frontdoor
     if frontdoor is None:
         raise protocol.ProtocolError(

@@ -244,7 +244,7 @@ class SamplingProfiler:  # protocol: start->close
             for (role, folded), count in items
         ) + ("\n" if items else "")
 
-    def snapshot(self, top=20):
+    def snapshot(self, top=20):  # schema: wire-debug-profile@v1
         """The `/debug/profile` payload: accounting + per-role sample
         split + the hottest `top` stacks."""
         with self._cv:

@@ -228,7 +228,7 @@ class SLOEngine:
                 return ex
         return None
 
-    def evaluate(self):
+    def evaluate(self):  # schema: wire-debug-slo@v1
         """One pull: read the fast and slow windows, compute burn
         rates, transition alert states, return the `slo` block."""
         slow = self._window.delta()

@@ -102,7 +102,7 @@ class WindowHistogram:
             return float("inf")
         return float(self.bounds[idx])
 
-    def to_payload(self):
+    def to_payload(self):  # schema: wire-debug-window@v1
         out = {
             "count": int(self.count),
             "rate_per_s": round(self.rate_per_s, 6),
@@ -417,7 +417,7 @@ class SlidingWindow:  # protocol: start->close
             old = self._ring[(self._head - k) % len(self._ring)]
         return WindowDelta(old, self._snap_cumulative())
 
-    def read(self, intervals=None):
+    def read(self, intervals=None):  # schema: wire-debug-window@v1
         """The `/debug/window` payload: the merged window view plus
         ring accounting and rotator health."""
         out = self.delta(intervals=intervals).to_payload()

@@ -327,7 +327,7 @@ class IngestPipeline:  # protocol: close
                 self._check_packer_locked()
                 self._cv.wait(_WAIT_S)
 
-    def close(self, drain=True, spill=False):
+    def close(self, drain=True, spill=False):  # schema: pipeline-spill@v1
         """Stop the pipeline and join the packer thread.
 
         drain=True processes everything still queued (lossless

@@ -93,7 +93,7 @@ class SnapshotError(RuntimeError):
     leaves a half-restored server."""
 
 
-def _array_entry(name, arr, offset):
+def _array_entry(name, arr, offset):  # schema: arena-snapshot@v1
     return {
         "name": name,
         "dtype": arr.dtype.name,
@@ -103,7 +103,7 @@ def _array_entry(name, arr, offset):
 
 
 def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
-                   store_state, ratings, queue):  # deterministic
+                   store_state, ratings, queue):  # deterministic; schema: arena-snapshot@v1
     """Write one snapshot directory: arrays.bin + manifest.json.
 
     `store_state` is `MergeableCSR.export_state()` output; `ratings` a
@@ -172,7 +172,7 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
     return manifest
 
 
-def read_snapshot(path):  # deterministic
+def read_snapshot(path):  # deterministic; schema: arena-snapshot@v1
     """Validate and load one snapshot directory.
 
     Returns `(manifest, arrays)` with every array materialized as an
@@ -702,7 +702,7 @@ class ArenaServer:  # protocol: close
         self._h_staleness.record(out["staleness"], trace_id=qspan.trace_id)
         return out
 
-    def query_batch(self, specs):
+    def query_batch(self, specs):  # schema: wire-query-batch@v1
         """Many lookups answered from ONE view.
 
         Each spec is a dict with any of the `query()` keyword shapes —
@@ -741,7 +741,7 @@ class ArenaServer:  # protocol: close
             }
 
     def _query_parts(self, view, stale, leaderboard, players, pairs,
-                     trace_id, staleness=None):
+                     trace_id, staleness=None):  # schema: wire-query-response@v1
         """Render one lookup's response parts against an already-chosen
         view. Deterministic in (view, arguments) apart from the
         engine's immutable Elo scale — the property the wire byte
@@ -807,7 +807,7 @@ class ArenaServer:  # protocol: close
             out["pairs"] = rows
         return out
 
-    def _player_row(self, view, p, rank=None):  # pure-render(view)
+    def _player_row(self, view, p, rank=None):  # pure-render(view); schema: wire-player-row@v1
         row = {
             "player": p,
             "rating": float(view.ratings[p]),
@@ -822,7 +822,7 @@ class ArenaServer:  # protocol: close
 
     # --- snapshot / restore ------------------------------------------
 
-    def snapshot(self, path, spill=False):
+    def snapshot(self, path, spill=False):  # schema: arena-snapshot@v1
         """Spill the engine to a durable snapshot directory.
 
         Default: the async pipeline (if any) is DRAINED first
@@ -879,7 +879,7 @@ class ArenaServer:  # protocol: close
             self._c_snapshots.inc()
             return manifest
 
-    def restore(self, path):
+    def restore(self, path):  # schema: arena-snapshot@v1
         """Reload a snapshot and resume mid-stream.
 
         Validation and assembly happen on fresh objects FIRST; the
@@ -922,7 +922,7 @@ class ArenaServer:  # protocol: close
         self.refresh_view()
         return manifest
 
-    def _assemble_store(self, manifest, arrays):
+    def _assemble_store(self, manifest, arrays):  # schema: arena-snapshot@v1
         """`MergeableCSR.from_state` with its ValueErrors upgraded to
         the snapshot-reject contract (distinct error, nothing
         installed). The delta tail is restored AS RUNS — dropping it
@@ -956,7 +956,7 @@ class ArenaServer:  # protocol: close
         self.engine.shutdown()
 
 
-def _split_queue(arrays):
+def _split_queue(arrays):  # schema: arena-snapshot@v1
     lengths = arrays["queue_lengths"]
     if not lengths.size:
         return []

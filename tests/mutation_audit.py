@@ -777,6 +777,49 @@ MUTATIONS = (
         "front_end == eventloop and the loop's named thread must be "
         "live)",
     ),
+    (
+        "schema-facts-extractor-returns-empty",
+        "arena/analysis/schema.py",
+        "    return _Facts(frozenset(produced), frozenset(consumed), "
+        "arrays, dtypes)",
+        "    return _Facts(frozenset(), frozenset(), (), {})",
+        "the fact extractor is the front end of all three shape rules; "
+        "returning empty facts makes every schema contract vacuously "
+        "clean (no produced keys, no consumed keys, no order) while the "
+        "rules still 'run' — killed by "
+        "test_extract_facts_collects_produced_consumed_arrays_dtypes "
+        "(and the bad_schema_drift/bad_undeclared_field corpus "
+        "contracts, which stop firing)",
+    ),
+    (
+        "version-bump-check-inverted",
+        "arena/analysis/schema.py",
+        "            return found > recorded  # a bump is "
+        "strictly-greater, never equal",
+        "            return found >= recorded  # >= : the recorded "
+        "version counts as bumped",
+        "a bump means the module constant moved PAST the recorded "
+        "version; under >= the unchanged constant (v1 == v1) reads as "
+        "already-bumped and every silent drift on a versioned format is "
+        "waved through — killed by "
+        "test_seeded_manifest_field_add_without_bump_is_flagged (the "
+        "seeded manifest field must flag while SNAPSHOT_VERSION sits at "
+        "the recorded version)",
+    ),
+    (
+        "replication-boundary-uses-one-hop-not-fixpoint",
+        "arena/analysis/schema.py",
+        "        while frontier:  # transitive apply closure, to "
+        "fixpoint over call edges",
+        "        if frontier:  # one hop only: direct callees of the "
+        "apply roots",
+        "the apply closure must be transitive: a helper two calls below "
+        "the `# deterministic` root still replays; a one-hop closure "
+        "flags it as outside the boundary, forcing exemptions onto "
+        "correct code — killed by "
+        "test_two_hop_closure_is_inside_the_boundary (apply -> _stage "
+        "-> _commit must lint clean)",
+    ),
 )
 
 

@@ -57,6 +57,11 @@ CORPUS_EXPECTED = {
     "bad_impure_render.py": {"hidden-state-read-in-pure-render"},
     "bad_check_then_act.py": {"check-then-act-race"},
     "bad_undeclared_mutation.py": {"undeclared-mutation-in-contract"},
+    # jaxlint v6: the serialized-schema contract analyzer.
+    "bad_schema_drift.py": {"schema-drift-without-version-bump"},
+    "bad_undeclared_field.py": {"undeclared-serialized-field"},
+    "bad_reader_writer_mismatch.py": {"reader-writer-schema-mismatch"},
+    "bad_replication_boundary_write.py": {"replication-boundary-write"},
 }
 
 # The --format=json per-finding schema (the mechanical consumption
@@ -744,3 +749,84 @@ def test_jobs_flag_cli_contract(capsys):
     assert jaxlint.main(["--jobs=4"] + CLEAN_TARGETS) == 0
     assert jaxlint.main(["--jobs=0", str(CORPUS)]) == 2
     assert "jobs" in capsys.readouterr().err
+
+
+# --- v6 satellites: parse memoization + --gate one-shot CI mode -----------
+
+
+def test_parse_memo_cold_vs_warm_bit_identical():
+    """The parse memo is a wall-clock knob ONLY: a cold run (cache
+    cleared) and a warm run over the corpus return byte-for-byte
+    identical findings, suppressed ones included."""
+    jaxlint.clear_parse_cache()
+    cold = jaxlint.lint_paths([str(CORPUS)], keep_suppressed=True)
+    warm = jaxlint.lint_paths([str(CORPUS)], keep_suppressed=True)
+    assert cold  # non-vacuous: the corpus does produce findings
+    assert [f.__dict__ for f in cold] == [f.__dict__ for f in warm]
+
+
+def test_parse_memo_warm_run_skips_reparse(monkeypatch):
+    """A warm run performs ZERO ast.parse calls (the memo serves the
+    tree + comment tables) — kills a memo that silently became a
+    no-op. The single jaxlint call site is the only parse in the
+    analysis package, so counting it is exact."""
+    jaxlint.clear_parse_cache()
+    target = str(CORPUS / "bad_timing.py")
+    jaxlint.lint_paths([target])
+    parses = []
+    real_parse = jaxlint.ast.parse
+
+    def counting_parse(*args, **kwargs):
+        parses.append(args)
+        return real_parse(*args, **kwargs)
+
+    monkeypatch.setattr(jaxlint.ast, "parse", counting_parse)
+    warm = jaxlint.lint_paths([target])
+    assert parses == [], "warm lint re-parsed a memoized file"
+    assert {f.rule for f in warm} == {"timing-without-block"}
+
+
+def test_parse_memo_does_not_serve_stale_trees(tmp_path):
+    """Content-keyed, not path/mtime-keyed: rewriting a file between
+    runs must yield the NEW file's findings — a stale hit here would
+    silently pass a dirty tree."""
+    target = tmp_path / "evolving.py"
+    target.write_text((CORPUS / "bad_timing.py").read_text())
+    assert {f.rule for f in jaxlint.lint_paths([str(target)])} == {
+        "timing-without-block"
+    }
+    target.write_text("x = 1\n")
+    assert jaxlint.lint_paths([str(target)]) == []
+
+
+def test_gate_one_shot_writes_sarif_next_to_rc(tmp_path, monkeypatch, capsys):
+    """`--gate` is the one-command CI mode: full registry over the
+    default targets, rc semantics unchanged (clean tree -> 0), and a
+    SARIF 2.1.0 document written to ./jaxlint.sarif for annotation
+    tooling. Suppressed findings appear in the document carrying
+    inSource suppression objects, never in the exit code."""
+    monkeypatch.chdir(tmp_path)
+    rc = jaxlint.main(["--gate"])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "jaxlint.sarif" in captured.err
+    doc = json.loads((tmp_path / "jaxlint.sarif").read_text())
+    assert doc["version"] == "2.1.0"
+    results = doc["runs"][0]["results"]
+    # rc was 0, so anything in the document must be suppressed-only.
+    assert all(
+        res.get("suppressions") == [{"kind": "inSource"}] for res in results
+    )
+
+
+def test_gate_rejects_conflicting_configuration(capsys):
+    """--gate IS the fixed configuration: combining it with explicit
+    paths, --rules/--disable, or --baseline is a usage error (rc 2)."""
+    for extra in (
+        [str(CORPUS)],
+        ["--rules=mutable-closure"],
+        ["--disable=mutable-closure"],
+        ["--baseline=b.json"],
+    ):
+        assert jaxlint.main(["--gate"] + extra) == 2
+        assert "--gate" in capsys.readouterr().err

@@ -1,0 +1,409 @@
+"""jaxlint v6 contracts: serialized-schema analysis + the replication
+boundary.
+
+Three layers, mirroring the analyzer:
+
+- grammar + fact extraction unit tests (`parse_schema`,
+  `_extract_facts`) — the shared front end every schema rule consumes;
+- seeded-drift demos against MUTATED COPIES of the real writers: add
+  a manifest field / reorder the array table in `arena/serving.py`
+  without bumping `SNAPSHOT_VERSION` and the linter objects; bump the
+  constant and it stands down. Same shape for the replication
+  boundary: graft a ratings-writing helper onto `ArenaEngine` outside
+  the apply closure and the linter objects. The real tree stays byte
+  and finding identical — mutations live in strings here, never on
+  disk;
+- sidecar registry hygiene: every checked-in schema JSON is
+  well-formed and self-consistent.
+
+Several tests here are the named kill-tests for the v6 mutation-audit
+entries (see tools/mutation_audit.py): the fact-extraction test kills
+`schema-facts-extractor-returns-empty`, the seeded field-add test
+kills `version-bump-check-inverted`, and the two-hop closure test
+kills `replication-boundary-uses-one-hop-not-fixpoint`.
+"""
+
+import ast
+import json
+import pathlib
+
+from arena.analysis import jaxlint, project, schema
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SERVING = REPO / "arena" / "serving.py"
+ENGINE = REPO / "arena" / "engine.py"
+
+SCHEMA_RULES = set(schema._RULE_NAMES)
+
+
+def _schema_findings(findings):
+    return sorted(
+        (f.rule, f.message) for f in findings if f.rule in SCHEMA_RULES
+    )
+
+
+# --- grammar ---------------------------------------------------------------
+
+
+def test_parse_schema_grammar():
+    assert project.parse_schema("schema: arena-snapshot@v1") == (
+        "arena-snapshot", 1
+    )
+    # The clause coexists with the v5 effect-contract clauses on one
+    # comment — the real annotation style in serving.py/frontdoor.py.
+    assert project.parse_schema(
+        "deterministic; mutates: a, b; schema: wire-envelope@v12"
+    ) == ("wire-envelope", 12)
+    assert project.parse_schema(
+        "pure-render(view); schema: wire-player-row@v1"
+    ) == ("wire-player-row", 1)
+    # Malformed clauses are no contract at all, never a guess.
+    for bad in (
+        "schema: missing-version",
+        "schema: bad@vX",
+        "schema: @v1",
+        "schemas: name@v1",
+        "deterministic; mutates: a",
+    ):
+        assert project.parse_schema(bad) is None
+
+
+def test_schema_clause_does_not_disturb_the_mutates_clause():
+    """`mutates:` parsing stops at the `;` so appending a schema clause
+    to an existing effect contract leaves the declared write set
+    unchanged."""
+    src = (
+        "class C:\n"
+        "    def apply(self, b):  # deterministic; mutates: ratings, log; schema: applied-log-record@v1\n"
+        "        self.ratings = b\n"
+        "        self.log = [b]\n"
+    )
+    ctx = jaxlint.ModuleContext("t.py", src)
+    contract = ctx.symbols.contracts["C.apply"]
+    assert contract["deterministic"] is True
+    assert set(contract["mutates"]) == {"ratings", "log"}
+    assert ctx.symbols.schemas["C.apply"] == ("applied-log-record", 1)
+
+
+def test_schema_contract_attaches_to_def_class_and_method():
+    src = (
+        "def writer(x):  # schema: fmt-a@v1\n"
+        "    return {'k': x}\n"
+        "class Codec:  # schema: fmt-b@v2\n"
+        "    def parse(self, raw):  # schema: fmt-c@v3\n"
+        "        return raw\n"
+    )
+    schemas = jaxlint.ModuleContext("t.py", src).symbols.schemas
+    assert schemas == {
+        "writer": ("fmt-a", 1),
+        "Codec": ("fmt-b", 2),
+        "Codec.parse": ("fmt-c", 3),
+    }
+
+
+# --- fact extraction -------------------------------------------------------
+
+
+def test_extract_facts_collects_produced_consumed_arrays_dtypes():
+    """The front end every schema rule consumes: dict keys and tagged
+    tuples are produced (with resolvable dtypes), `.get`/subscript
+    loads/membership tuples/iteration tuples are consumed, and the
+    `[("name", arr), ...]` table yields the array order. An extractor
+    returning empty facts makes every downstream rule vacuous — this
+    is the named kill for the `schema-facts-extractor-returns-empty`
+    mutant."""
+    src = (
+        "import numpy as np\n"
+        "def roundtrip(state, payload, arrs):\n"
+        "    table = [\n"
+        "        ('keys', arrs['keys']),\n"
+        "        ('ratings', np.asarray(arrs['r'], np.float32)),\n"
+        "    ]\n"
+        "    out = {\n"
+        "        'magic': 'X',\n"
+        "        'count': np.zeros(3, dtype='int32'),\n"
+        "        'arrays': table,\n"
+        "    }\n"
+        "    out['checksum'] = 'abc'\n"
+        "    want = payload.get('version')\n"
+        "    for key in ('num_rows', 'num_cols'):\n"
+        "        state[key] = payload[key]\n"
+        "    if 'stale' in ('stale', 'fresh'):\n"
+        "        pass\n"
+        "    required = {'queue_batches'}\n"
+        "    tag = ('ratings', np.asarray(arrs['r'], np.float32))\n"
+        "    return out, want, required, tag\n"
+    )
+    fn = ast.parse(src).body[1]
+    facts = schema._extract_facts(fn)
+    assert {"magic", "count", "arrays", "checksum", "ratings"} <= facts.produced
+    # Iteration/membership tuples are reader collections, not tags...
+    assert {"version", "num_rows", "num_cols", "stale", "fresh",
+            "queue_batches"} <= facts.consumed
+    # ...and never leak into produced.
+    assert "num_rows" not in facts.produced
+    assert facts.arrays == ("keys", "ratings")
+    assert facts.dtypes["count"] == "int32"
+    assert facts.dtypes["ratings"] == "float32"
+    # Consumed subscripts: state[key] has a Name slice — no claim. But
+    # payload[key] under the same loop reads the iterated keys via the
+    # loop tuple, which is the claim the rule needs.
+
+
+def test_extract_facts_no_claim_on_dynamic_shapes():
+    src = (
+        "def opaque(d, k, v):\n"
+        "    d[k] = v\n"
+        "    return {k: v for k in d}\n"
+    )
+    fn = ast.parse(src).body[0]
+    facts = schema._extract_facts(fn)
+    assert facts.produced == frozenset()
+    assert facts.consumed == frozenset()
+    assert facts.arrays == ()
+
+
+# --- sidecar plumbing ------------------------------------------------------
+
+
+def test_missing_sidecar_is_a_drift_finding(tmp_path):
+    src = (
+        "def writer(x):  # schema: nobody-recorded-this@v1\n"
+        "    return {'k': x}\n"
+    )
+    findings = jaxlint.lint_source(src, str(tmp_path / "mod.py"))
+    assert [(f.rule,) for f in findings] == [(schema.RULE_DRIFT,)]
+    assert "no recorded shape" in findings[0].message
+
+
+def test_local_sidecar_shadows_the_global_registry(tmp_path):
+    """A `schemas/` directory next to the module wins over the global
+    registry — corpus fixtures carry their own shapes."""
+    (tmp_path / "schemas").mkdir()
+    (tmp_path / "schemas" / "wire-envelope.json").write_text(
+        json.dumps({"schema": "wire-envelope", "fields": ["totally_local"]})
+    )
+    src = (
+        "def render(w):  # schema: wire-envelope@v1\n"
+        "    return {'totally_local': w}\n"
+    )
+    assert jaxlint.lint_source(src, str(tmp_path / "mod.py")) == []
+    # The same source against the REAL wire-envelope sidecar fires.
+    real = jaxlint.lint_source(src, str(REPO / "arena" / "net" / "x.py"))
+    assert [(f.rule,) for f in real] == [(schema.RULE_UNDECLARED,)]
+
+
+def test_real_sidecars_are_well_formed():
+    """Registry hygiene: every checked-in sidecar parses, names itself
+    after its file, declares unique string fields, and versioned ones
+    carry an int version plus the module constant to bump."""
+    paths = sorted(schema.SCHEMAS_DIR.glob("*.json"))
+    assert len(paths) >= 18
+    for path in paths:
+        record = json.loads(path.read_text())
+        if path.stem == "replication-boundary":
+            for cls, entry in record["exempt"].items():
+                assert entry["attrs"] and entry["why"], cls
+            continue
+        assert record["schema"] == path.stem
+        fields = record["fields"]
+        assert isinstance(fields, list)
+        assert all(isinstance(f, str) for f in fields)
+        assert len(set(fields)) == len(fields)
+        if "version_constant" in record:
+            assert isinstance(record["version"], int)
+            assert isinstance(record["version_constant"], str)
+        for key in record.get("dtypes", {}):
+            assert key in fields or key in record.get("arrays", ())
+
+
+# --- seeded drift against the real snapshot writer -------------------------
+
+
+def _lint_serving(src):
+    return _schema_findings(jaxlint.lint_source(src, str(SERVING)))
+
+
+def test_pristine_serving_has_no_schema_findings():
+    assert _lint_serving(SERVING.read_text()) == []
+
+
+def test_seeded_manifest_field_add_without_bump_is_flagged():
+    """Add one field to the snapshot manifest without touching
+    SNAPSHOT_VERSION: the drift rule objects and names the field. This
+    is the named kill for the `version-bump-check-inverted` mutant —
+    under `>=`, v1 == v1 would count as bumped and this seeded drift
+    would sail through."""
+    src = SERVING.read_text().replace(
+        '"bin_bytes": len(blob),',
+        '"bin_bytes": len(blob),\n        "spare_field": 0,',
+    )
+    assert src != SERVING.read_text()
+    found = _lint_serving(src)
+    assert [rule for rule, _msg in found] == [schema.RULE_DRIFT]
+    assert "spare_field" in found[0][1]
+    assert "SNAPSHOT_VERSION" in found[0][1]
+
+
+def test_seeded_array_reorder_without_bump_is_flagged():
+    """Swap two entries of the arrays.bin table: offsets shift, every
+    deployed reader slices garbage — flagged without a bump."""
+    src = SERVING.read_text().replace(
+        '        ("winners", store_state["winners"]),\n'
+        '        ("losers", store_state["losers"]),',
+        '        ("losers", store_state["losers"]),\n'
+        '        ("winners", store_state["winners"]),',
+    )
+    assert src != SERVING.read_text()
+    found = _lint_serving(src)
+    assert [rule for rule, _msg in found] == [schema.RULE_DRIFT]
+    assert "array order" in found[0][1]
+
+
+def test_version_bump_suppresses_schema_drift():
+    """The sanctioned evolution path: the same seeded field-add WITH
+    `SNAPSHOT_VERSION` bumped past the recorded version lints clean —
+    the rule polices silent drift, not evolution."""
+    src = SERVING.read_text().replace(
+        '"bin_bytes": len(blob),',
+        '"bin_bytes": len(blob),\n        "spare_field": 0,',
+    ).replace("SNAPSHOT_VERSION = 1", "SNAPSHOT_VERSION = 2")
+    assert _lint_serving(src) == []
+
+
+def test_seeded_dtype_change_without_bump_is_flagged():
+    """Serialize ratings as float64 while the sidecar records float32:
+    readers allocate and slice the wrong width — flagged."""
+    src = SERVING.read_text().replace(
+        '("ratings", np.asarray(ratings, np.float32)),',
+        '("ratings", np.asarray(ratings, np.float64)),',
+    )
+    assert src != SERVING.read_text()
+    found = _lint_serving(src)
+    assert [rule for rule, _msg in found] == [schema.RULE_DRIFT]
+    assert "float32 -> float64" in found[0][1]
+
+
+# --- the replication boundary ----------------------------------------------
+
+
+def test_pristine_engine_has_no_schema_findings():
+    assert _schema_findings(
+        jaxlint.lint_source(ENGINE.read_text(), str(ENGINE))
+    ) == []
+
+
+def test_seeded_out_of_closure_ratings_write_is_flagged():
+    """Graft a helper onto ArenaEngine that rescales `self.ratings` in
+    place, reachable from no `# deterministic` apply root: a replica
+    replaying the match log never runs it — flagged, naming the
+    attribute."""
+    src = ENGINE.read_text() + (
+        "\n"
+        "    def sneaky_refit(self, scale):\n"
+        "        self.ratings = self.ratings * scale\n"
+    )
+    found = _schema_findings(jaxlint.lint_source(src, str(ENGINE)))
+    assert [rule for rule, _msg in found] == [schema.RULE_BOUNDARY]
+    assert "sneaky_refit" in found[0][1]
+    assert "ratings" in found[0][1]
+
+
+def test_two_hop_closure_is_inside_the_boundary(tmp_path):
+    """The closure is computed to a FIXPOINT over resolved call edges:
+    apply -> _stage -> _commit, where only the two-hop callee writes
+    the declared state. Clean — the write replays. This is the named
+    kill for the `replication-boundary-uses-one-hop-not-fixpoint`
+    mutant, which stops after the roots' direct callees and would flag
+    `_commit` as outside the boundary."""
+    src = (
+        "class Replica:\n"
+        "    def __init__(self):\n"
+        "        self.ratings = {}\n"
+        "        self.applied = 0\n"
+        "    def apply(self, batch):  # deterministic; mutates: ratings, applied\n"
+        "        for rec in batch:\n"
+        "            self._stage(rec)\n"
+        "    def _stage(self, rec):\n"
+        "        self._commit(rec[0], rec[1])\n"
+        "    def _commit(self, player, delta):\n"
+        "        self.ratings[player] = self.ratings.get(player, 0.0) + delta\n"
+        "        self.applied += 1\n"
+    )
+    assert jaxlint.lint_source(src, str(tmp_path / "mod.py")) == []
+
+
+def test_replication_exemption_sidecar_is_honored(tmp_path):
+    """An admission-path attribute exempted (with a reason) in the
+    class's replication-boundary sidecar stops protecting — the
+    FrontDoor intake-buffer pattern. Without the exemption the same
+    source is flagged."""
+    src = (
+        "class Door:\n"
+        "    def __init__(self):\n"
+        "        self.buffer = []\n"
+        "        self.applied = 0\n"
+        "    def apply(self, batch):  # deterministic; mutates: applied, buffer\n"
+        "        self.applied += 1\n"
+        "        self.buffer = self.buffer[1:]\n"
+        "    def admit(self, rec):\n"
+        "        self.buffer.append(rec)\n"
+    )
+    flagged = jaxlint.lint_source(src, str(tmp_path / "mod.py"))
+    assert [f.rule for f in flagged] == [schema.RULE_BOUNDARY]
+    (tmp_path / "schemas").mkdir()
+    (tmp_path / "schemas" / "replication-boundary.json").write_text(
+        json.dumps({"exempt": {"Door": {
+            "attrs": ["buffer"],
+            "why": "intake staging; drained by the apply path",
+        }}})
+    )
+    assert jaxlint.lint_source(src, str(tmp_path / "mod.py")) == []
+
+
+def test_lifecycle_and_protocol_methods_are_exempt(tmp_path):
+    """__init__ seeds replicated state (replay lands ON it) and v4
+    `# protocol:` teardown methods run outside replay by design —
+    neither is a boundary violation."""
+    src = (
+        "class Replica:  # protocol: close\n"
+        "    def __init__(self):\n"
+        "        self.ratings = {}\n"
+        "    def apply(self, batch):  # deterministic; mutates: ratings\n"
+        "        for player, delta in batch:\n"
+        "            self.ratings[player] = delta\n"
+        "    def close(self):\n"
+        "        self.ratings = {}\n"
+    )
+    assert jaxlint.lint_source(src, str(tmp_path / "mod.py")) == []
+
+
+# --- reader/writer + unversioned wire fixtures -----------------------------
+
+
+def test_undeclared_field_and_mismatch_fixtures(tmp_path):
+    (tmp_path / "schemas").mkdir()
+    (tmp_path / "schemas" / "tiny-wire.json").write_text(
+        json.dumps({"schema": "tiny-wire", "fields": ["status", "rows"]})
+    )
+    writer = (
+        "def render(rows):  # schema: tiny-wire@v1\n"
+        "    return {'status': 'ok', 'rows': rows, 'extra': 1}\n"
+    )
+    found = jaxlint.lint_source(writer, str(tmp_path / "w.py"))
+    assert [f.rule for f in found] == [schema.RULE_UNDECLARED]
+    assert "extra" in found[0].message
+    reader = (
+        "def parse(payload):  # schema: tiny-wire@v1\n"
+        "    return payload['rows'], payload.get('row_count')\n"
+    )
+    found = jaxlint.lint_source(reader, str(tmp_path / "r.py"))
+    assert [f.rule for f in found] == [schema.RULE_MISMATCH]
+    assert "row_count" in found[0].message
+    # Touching a strict subset of declared fields is fine: facts are
+    # one-sided, a reader is never required to consume everything.
+    subset = (
+        "def peek(payload):  # schema: tiny-wire@v1\n"
+        "    return payload.get('status')\n"
+    )
+    assert jaxlint.lint_source(subset, str(tmp_path / "s.py")) == []

@@ -52,22 +52,32 @@ EFFECTS_RULES = {
     "undeclared-mutation-in-contract",
 }
 
+# jaxlint v6: the serialized-schema contract analyzer.
+SCHEMA_RULES = {
+    "schema-drift-without-version-bump",
+    "reader-writer-schema-mismatch",
+    "undeclared-serialized-field",
+    "replication-boundary-write",
+}
+
 
 def test_full_tree_lints_clean_with_concurrency_rules_active():
     """The acceptance criterion: `python -m arena.analysis` over the
     clean tree reports 0 findings WITH the four concurrency rules, the
     three v3 abstract-interpretation families, the four v4 lifecycle
-    rules, AND the four v5 effect-contract rules registered — the real
+    rules, the four v5 effect-contract rules, AND the four v6
+    serialized-schema rules registered — the real
     guarded_by annotations, the real bucketing/validator call sites,
     the real `# protocol:` contracts, and the real `# deterministic` /
     `# pure-render` contracts all in place. Runs with jobs=2: the
-    22-rule pass stays fast, and the parallel path is exercised on
+    26-rule pass stays fast, and the parallel path is exercised on
     every suite run (bit-identity to serial is pinned in
     test_analysis_lint.py)."""
     assert CONCURRENCY_RULES <= set(jaxlint.RULES)
     assert ABSINT_RULES <= set(jaxlint.RULES)
     assert LIFECYCLE_RULES <= set(jaxlint.RULES)
     assert EFFECTS_RULES <= set(jaxlint.RULES)
+    assert SCHEMA_RULES <= set(jaxlint.RULES)
     findings = jaxlint.lint_paths(jaxlint.default_targets(), jobs=2)
     assert findings == [], "\n" + "\n".join(f.format() for f in findings)
 
@@ -156,6 +166,29 @@ def test_clean_pass_is_not_vacuous():
                 assert contract["pure_render"] == "view", (
                     f"{rel}: {qualname} no longer `# pure-render(view)`"
                 )
+    # ...and (v6) the schema pass demonstrably sees the real
+    # `# schema:` contracts on the snapshot, wire, and replication-log
+    # writers — the shapes the sidecar registry pins.
+    schemas = {
+        "arena/serving.py": {
+            "write_snapshot": ("arena-snapshot", 1),
+            "ArenaServer._player_row": ("wire-player-row", 1),
+        },
+        "arena/net/protocol.py": {
+            "make_response": ("wire-envelope", 1),
+            "parse_submit_body": ("wire-submit-request", 1),
+        },
+        "arena/net/frontdoor.py": {
+            "FrontDoor._apply": ("applied-log-record", 1),
+        },
+    }
+    for rel, expected in schemas.items():
+        path = REPO / rel
+        ctx = jaxlint.ModuleContext(str(path), path.read_text())
+        for qualname, declared in expected.items():
+            assert ctx.symbols.schemas.get(qualname) == declared, (
+                f"{rel}: {qualname} lost its `# schema:` contract"
+            )
 
 
 def test_every_registered_rule_fires_on_the_corpus():

@@ -78,7 +78,10 @@ def test_mutations_cover_every_policed_surface():
     (the call-graph fixpoint, the check-then-act re-check credit, the
     pure-render parameter exemption), and since PR 16 the fast wire
     path (the byte cache's view-generation check, the batch endpoint's
-    one-view contract, the event-loop read front end's default)."""
+    one-view contract, the event-loop read front end's default), and
+    since PR 17 the jaxlint v6 schema analyzer (the shape-fact
+    extractor, the version-bump comparison direction, the replication
+    closure's fixpoint)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -89,6 +92,7 @@ def test_mutations_cover_every_policed_surface():
         "arena/analysis/cfg.py",
         "arena/analysis/lifecycle.py",
         "arena/analysis/effects.py",
+        "arena/analysis/schema.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",
@@ -132,6 +136,7 @@ def _fake_sources_only(dest):
         "arena/analysis/cfg.py",
         "arena/analysis/lifecycle.py",
         "arena/analysis/effects.py",
+        "arena/analysis/schema.py",
         "arena/ingest.py",
         "arena/pipeline.py",
         "arena/serving.py",

@@ -358,3 +358,92 @@ def test_hostile_label_values_round_trip_through_the_wire_stats(wire):
     for line in text.splitlines():
         assert not line.endswith('ev"il'), "unescaped newline split a line"
     assert "# HELP arena_http_requests_total" in text
+
+
+# --- the golden envelope: exact response shapes (jaxlint v6) ---------------
+
+# Every JSON endpoint's EXACT top-level key set. This is the live half
+# of the v6 schema contracts: the linter pins the renderers' shape
+# facts against the checked-in sidecars statically, this table pins
+# the real HTTP responses against the same shapes at runtime. A key
+# added or dropped anywhere in the render stack fails here in the
+# same commit — wire drift is a reviewed diff of this table plus the
+# sidecar, never a surprise in a reader's parser.
+_ENVELOPE = {"watermark", "trace_id"}
+_QUERY_PARTS = {"matches_ingested", "staleness", "stale", "view_seq",
+                "view_ratings_sum"}
+GOLDEN_RESPONSE_KEYS = {
+    "/healthz": _ENVELOPE | {"status", "front_end", "players",
+                             "matches_ingested"},
+    "/leaderboard?offset=0&limit=5": _ENVELOPE | _QUERY_PARTS | {"leaderboard"},
+    "/player/3": _ENVELOPE | _QUERY_PARTS | {"players"},
+    "/h2h?a=1&b=2": _ENVELOPE | _QUERY_PARTS | {"pairs"},
+    "/debug/window": _ENVELOPE | {"window_s", "counters", "gauges",
+                                  "histograms", "ring"},
+    "/debug/slo": _ENVELOPE | {"objectives", "alerts_active",
+                               "alerts_fired_total", "window_s"},
+    "/debug/profile": _ENVELOPE | {"hz", "samples", "running", "error",
+                                   "roles", "top"},
+}
+
+
+def test_every_endpoint_matches_its_golden_key_set(wire):
+    server, client = wire
+    for path, expected in GOLDEN_RESPONSE_KEYS.items():
+        _status, resp = client.get(path)
+        assert set(resp) == expected, (
+            f"{path}: {sorted(set(resp) ^ expected)} drifted"
+        )
+    # POST endpoints: the batch query and submit acks.
+    _status, batch = client.batch_query([{"leaderboard": [0, 3]}])
+    assert set(batch) == _ENVELOPE | {"view_seq", "stale", "queries",
+                                      "results"}
+    # Each batch result is a full per-query response: envelope, query
+    # parts, and the requested view slice.
+    assert set(batch["results"][0]) == _ENVELOPE | _QUERY_PARTS | {
+        "leaderboard"
+    }
+    status, ack = client.submit([0, 1], [2, 3], producer="golden-test")
+    assert status == 202
+    assert set(ack) == _ENVELOPE | {"seq", "producer", "matches",
+                                    "pending_batches"}
+    server.frontdoor.flush()
+    # /debug/trace: resolve a real id so the shape is the found-path one.
+    _status, page = client.get("/leaderboard?offset=0&limit=1")
+    _status, traced = client.get(f"/debug/trace/{page['trace_id']}")
+    assert set(traced) == _ENVELOPE | {"queried_trace_id", "spans"}
+    # Row shapes: the leaderboard player row is itself a contracted
+    # schema (wire-player-row) — pin it too.
+    _status, board = client.get("/leaderboard?offset=0&limit=3")
+    for row in board["leaderboard"]:
+        assert set(row) == {"player", "rating", "lo", "hi", "wins",
+                            "losses", "rank"}
+
+
+def test_golden_key_sets_stay_inside_the_checked_in_sidecars():
+    """The bridge between this file's live table and the linter's
+    static sidecars: every key the golden table pins is declared by
+    the corresponding schema sidecar (fields + envelope), so the two
+    shape sources cannot drift apart silently."""
+    import json as _json
+
+    from arena.analysis.schema import SCHEMAS_DIR
+
+    def declared(name):
+        record = _json.loads((SCHEMAS_DIR / f"{name}.json").read_text())
+        return set(record["fields"]) | set(record.get("arrays", ()))
+
+    by_sidecar = {
+        "/healthz": "wire-healthz",
+        "/leaderboard?offset=0&limit=5": "wire-query-response",
+        "/player/3": "wire-query-response",
+        "/h2h?a=1&b=2": "wire-query-response",
+        "/debug/window": "wire-debug-window",
+        "/debug/slo": "wire-debug-slo",
+        "/debug/profile": "wire-debug-profile",
+    }
+    envelope = declared("wire-envelope")
+    assert envelope == _ENVELOPE
+    for path, sidecar in by_sidecar.items():
+        undeclared = GOLDEN_RESPONSE_KEYS[path] - declared(sidecar) - envelope
+        assert not undeclared, f"{path}: {sorted(undeclared)} not in {sidecar}"
