@@ -126,8 +126,31 @@ dangling orphan spans may exist at quiescence (summary-batch compiles
 in this phase are legitimately outside the steady-state window — the
 coalesced shapes are new by construction).
 
+A seventh mode, ``ARENA_BENCH_MODE=replica``, measures the REPLICATED
+READ FLEET (`arena/net/replica.py`): the writer cuts a FULL snapshot,
+churns ~10% more matches through the front door, then cuts an
+INCREMENTAL snapshot (chained on the full) and a second full snapshot
+at the same watermark — HARD-gated ``full_bytes >= 5x inc_bytes`` (the
+delta cut must actually be a delta). Two replicas restore the
+incremental chain and tail the writer's ``GET /log`` over real
+localhost HTTP; producers then stream more batches into the writer
+WHILE readers page the replicas — the catch-up HARD gate requires both
+replicas to reach the writer's settled watermark within a bound, the
+bit-exactness HARD gate requires replica ratings identical to the
+writer's at equal watermark (``max_rating_diff`` 0.0 — same records,
+same order, same kernels), a thread-aware `RecompileSentinel` requires
+zero steady-state compiles across writer and replica replay threads,
+and the scale-out HARD gate requires the fleet's aggregate read
+throughput to hold at least ``ARENA_BENCH_REPLICA_SCALEOUT_MIN`` (0.75)
+of the single-server figure — on a single-core image the fleet cannot
+exceed one server's CPU ceiling, so the gate polices a structural
+penalty in the replica read path (a cache bypass, a per-query replay)
+rather than demanding parallel speedup; the measured ratio is reported
+for multi-core boxes. The headline ``value`` is the fleet's aggregate
+wire queries/s.
+
 Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest | pipeline |
-serve | soak | frontend),
+serve | soak | frontend | replica),
 ARENA_BENCH_MATCHES (100000), ARENA_BENCH_PLAYERS (1000),
 ARENA_BENCH_BATCH (8192), ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED
 (0), ARENA_BENCH_BT_ITERS (25), ARENA_BENCH_TOL (0.5 rating points —
@@ -145,7 +168,14 @@ producer), ARENA_BENCH_OVERLOAD_BATCHES (8 per producer, the forced-
 overload phase), ARENA_BENCH_FRONTDOOR_CAPACITY (4, the overload
 phase's reorder-buffer bound in batches), ARENA_BENCH_SHED_STALENESS
 (2x ARENA_BENCH_DELTA, the overload phase's summary backlog bound in
-matches),
+matches), ARENA_BENCH_REPLICAS (2, replica mode),
+ARENA_BENCH_CATCHUP_BATCHES (4 per producer, replica mode's
+concurrent-ingest phase), ARENA_BENCH_CATCHUP_TIMEOUT_S (60, the
+catch-up lag bound), ARENA_BENCH_READ_WINDOW_S (0.5, each read-
+throughput measurement window), ARENA_BENCH_REPLICA_SCALEOUT_MIN
+(0.75, the aggregate-vs-single-server floor),
+ARENA_BENCH_INC_RATIO_MIN (5.0, the full-vs-incremental snapshot
+bytes floor),
 ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
 sharded path when the backend is not yet initialized),
 ARENA_BENCH_HISTORY (unset — append every emitted JSON line to this
@@ -304,6 +334,14 @@ class FrontendGateError(AssertionError):
     staleness bound, a shed trace did not end with its dropped marker,
     dangling orphan spans survived quiescence, or the forced overload
     failed to shed at all (an un-exercised gate is no gate)."""
+
+
+class ReplicaGateError(AssertionError):
+    """A replica-bench hard gate failed: the incremental snapshot gave
+    up its size win over a full cut, the replica fleet's aggregate read
+    throughput fell structurally below one server's, catch-up lag blew
+    its bound under concurrent wire ingest, or a steady-state record
+    replay recompiled."""
 
 
 def _env_int(name, default):
@@ -1783,6 +1821,408 @@ def run_frontend_benchmark():
     }
 
 
+def _dir_bytes(path):
+    """Total on-disk payload of one snapshot directory."""
+    return sum(
+        f.stat().st_size for f in pathlib.Path(path).rglob("*") if f.is_file()
+    )
+
+
+def _replica_read_phase(targets, readers_per_target, duration_s,
+                        num_players, errors):
+    """Drive `readers_per_target` wire readers against every (host,
+    port) target for `duration_s`; returns (total_queries, elapsed_s,
+    per_target_queries). Readers alternate a leaderboard page with a
+    player row — the dashboard-shaped single-GET mix."""
+    stop = threading.Event()
+    n_targets = len(targets)
+    counts = [0] * (n_targets * readers_per_target)
+
+    def reader(idx, host, port):
+        client = net.WireClient(host, port)
+        pid = (idx * 11) % num_players
+        try:
+            while not stop.is_set():
+                for path in (
+                    "/leaderboard?offset=0&limit=10", f"/player/{pid}"
+                ):
+                    status, _resp = client.get(path)
+                    if status != 200:
+                        errors.append(f"reader {idx}: {path} -> {status}")
+                        return
+                    counts[idx] += 1
+        finally:
+            client.close()
+
+    threads = []
+    for t_idx, (host, port) in enumerate(targets):
+        for r in range(readers_per_target):
+            idx = t_idx * readers_per_target + r
+            threads.append(threading.Thread(
+                target=reader, args=(idx, host, port), daemon=True
+            ))
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    elapsed = time.perf_counter() - t0
+    per_target = [
+        sum(counts[t_idx * readers_per_target:(t_idx + 1) * readers_per_target])
+        for t_idx in range(n_targets)
+    ]
+    return sum(counts), elapsed, per_target
+
+
+def run_replica_benchmark():
+    """The replicated-read-fleet measurement: incremental snapshots,
+    applied-log shipping over real localhost HTTP, and replica reads
+    under concurrent writer ingest. See the module docstring's replica
+    paragraph for the five HARD gates."""
+    from arena.net import replica as replica_mod
+
+    base_matches = _env_int("ARENA_BENCH_MATCHES", 100_000)
+    stream_batch = _env_int("ARENA_BENCH_DELTA", 10_000)
+    num_players = _env_int("ARENA_BENCH_PLAYERS", 1_000)
+    batch = _env_int("ARENA_BENCH_BATCH", 8_192)
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+    num_replicas = _env_int("ARENA_BENCH_REPLICAS", 2)
+    producers = _env_int("ARENA_BENCH_PRODUCERS", 2)
+    readers = _env_int("ARENA_BENCH_READERS", 2)
+    catchup_batches = _env_int("ARENA_BENCH_CATCHUP_BATCHES", 4)
+    catchup_timeout_s = float(
+        os.environ.get("ARENA_BENCH_CATCHUP_TIMEOUT_S", 60.0)
+    )
+    window_s = float(os.environ.get("ARENA_BENCH_READ_WINDOW_S", 0.5))
+    scaleout_min = float(
+        os.environ.get("ARENA_BENCH_REPLICA_SCALEOUT_MIN", 0.75)
+    )
+    inc_ratio_min = float(os.environ.get("ARENA_BENCH_INC_RATIO_MIN", 5.0))
+    tol = float(os.environ.get("ARENA_BENCH_TOL", 0.0))
+
+    # 10% churn between the full cut and the incremental cut, in
+    # front-door batches of the stream size (the log records the
+    # replicas will replay are exactly these shapes).
+    churn_batches = max(1, (base_matches // 10) // stream_batch)
+    churn_matches = churn_batches * stream_batch
+    streamed = producers * catchup_batches * stream_batch
+    total = base_matches + churn_matches + stream_batch + streamed
+    winners, losers = make_matches(total, num_players, seed)
+
+    obs_live = obs_pkg.Observability(trace_capacity=16384)
+    _register_active_obs(obs_live)
+    obs_live.enable_ops(interval_s=1.0, intervals=60)
+    # Same ownership transfer as the frontend mode: `wire.close()` in
+    # the teardown stops the ops plane; on a gate failure the one-shot
+    # process exits and the daemon ops threads die with it.
+    obs_live.start_ops()  # jaxlint: disable=resource-leaked-on-exception
+    srv = serving.ArenaServer(
+        num_players=num_players,
+        max_staleness_matches=stream_batch,
+        obs=obs_live,
+    )
+    eng = srv.engine
+    for start, stop in _batch_slices(base_matches, batch):
+        eng.ingest(winners[start:stop], losers[start:stop])
+    frontdoor = net.FrontDoor(
+        eng,
+        capacity=producers * catchup_batches + churn_batches + 4,
+        max_staleness_matches=total,
+        record_applied=True,
+    )
+    wire = net.ArenaHTTPServer(srv, frontdoor=frontdoor).start()
+
+    snap_root = pathlib.Path(
+        os.environ.get("ARENA_DEBUG_DIR")
+        or tempfile.mkdtemp(prefix="arena-replica-bench-")
+    )
+    # --- the snapshot-size HARD gate: full, churn, incremental -------
+    full_a = snap_root / "full-base"
+    t0 = time.perf_counter()
+    srv.snapshot(full_a)
+    full_a_s = time.perf_counter() - t0
+
+    cursor = base_matches
+    for _ in range(churn_batches):
+        frontdoor.submit(
+            winners[cursor:cursor + stream_batch],
+            losers[cursor:cursor + stream_batch],
+            producer="churn",
+        )
+        cursor += stream_batch
+    frontdoor.flush()
+
+    inc_b = snap_root / "inc"
+    t0 = time.perf_counter()
+    srv.snapshot(inc_b, base=full_a)
+    inc_s = time.perf_counter() - t0
+    full_c = snap_root / "full-same-watermark"
+    t0 = time.perf_counter()
+    srv.snapshot(full_c)
+    full_s = time.perf_counter() - t0
+    inc_bytes = _dir_bytes(inc_b)
+    full_bytes = _dir_bytes(full_c)
+    bytes_ratio = full_bytes / inc_bytes if inc_bytes else float("inf")
+    if bytes_ratio < inc_ratio_min:
+        raise ReplicaGateError(
+            f"incremental snapshot is only {bytes_ratio:.2f}x smaller "
+            f"than a full cut at the same watermark ({inc_bytes} vs "
+            f"{full_bytes} bytes at {churn_matches} churned matches); "
+            f"the delta cut must stay >= {inc_ratio_min:g}x smaller or "
+            "it is a full snapshot wearing a manifest chain"
+        )
+    inc_manifest = serving._read_manifest(inc_b)
+
+    # --- the replica fleet: restore the incremental chain, tail /log --
+    replicas = []
+    try:
+        for r_idx in range(num_replicas):
+            r_obs = obs_pkg.Observability()
+            r_srv = serving.ArenaServer(
+                num_players=num_players,
+                max_staleness_matches=stream_batch,
+                obs=r_obs,
+            )
+            reader = replica_mod.ReplicaReader(
+                r_srv, wire.host, wire.port, snapshot=inc_b
+            )
+            reader.start()
+            r_wire = net.ArenaHTTPServer(r_srv, frontdoor=None).start()
+            replicas.append((r_srv, reader, r_wire))
+
+        # Warmup: one streamed batch compiles the replay bucket on
+        # every replica engine (and the first view render on every
+        # replica wire) BEFORE the sentinel arms.
+        warm = net.WireClient(wire.host, wire.port)
+        status, _resp = warm.submit(
+            winners[cursor:cursor + stream_batch],
+            losers[cursor:cursor + stream_batch],
+            producer="warmup",
+        )
+        assert status == net.server.STATUS_ACCEPTED
+        warm.close()
+        cursor += stream_batch
+        frontdoor.flush()
+        warm_wm = int(eng.matches_applied)
+        for _r_srv, reader, r_wire in replicas:
+            reader.wait_for_watermark(warm_wm, timeout=catchup_timeout_s)
+            probe = net.WireClient(r_wire.host, r_wire.port)
+            probe.get("/leaderboard?offset=0&limit=10")
+            probe.close()
+
+        sentinel = sanitize.RecompileSentinel(**{
+            "writer": eng.num_compiles,
+            **{
+                f"replica{i}": r_srv.engine.num_compiles
+                for i, (r_srv, _reader, _r_wire) in enumerate(replicas)
+            },
+        })
+
+        read_errors = []
+        # --- phase A: one server, quiet (the scale-out denominator) --
+        single_queries, single_elapsed, _per = _replica_read_phase(
+            [(wire.host, wire.port)], readers, window_s, num_players,
+            read_errors,
+        )
+        single_qps = single_queries / single_elapsed
+
+        # --- phase B: concurrent wire ingest + replica reads; the
+        # catch-up lag HARD gate ----------------------------------------
+        staleness_peak = [0]
+        ingest_stop = threading.Event()
+
+        def staleness_monitor():
+            while not ingest_stop.is_set():
+                for _r_srv, reader, _r_wire in replicas:
+                    lag = reader.staleness_matches()
+                    if lag > staleness_peak[0]:
+                        staleness_peak[0] = lag
+                time.sleep(0.01)
+
+        def producer(pid):
+            client = net.WireClient(wire.host, wire.port)
+            try:
+                for b in range(catchup_batches):
+                    start = (
+                        base_matches + churn_matches + stream_batch
+                        + (pid * catchup_batches + b) * stream_batch
+                    )
+                    status, _resp = client.submit(
+                        winners[start:start + stream_batch],
+                        losers[start:start + stream_batch],
+                        producer=f"bench-{pid}",
+                    )
+                    if status != net.server.STATUS_ACCEPTED:
+                        read_errors.append(f"producer {pid}: -> {status}")
+                        return
+            finally:
+                client.close()
+
+        monitor = threading.Thread(target=staleness_monitor, daemon=True)
+        monitor.start()
+        producer_threads = [
+            threading.Thread(target=producer, args=(pid,), daemon=True)
+            for pid in range(producers)
+        ]
+        ingest_t0 = time.perf_counter()
+        for t in producer_threads:
+            t.start()
+        replica_targets = [
+            (r_wire.host, r_wire.port) for _s, _r, r_wire in replicas
+        ]
+        during_queries, _during_elapsed, _per = _replica_read_phase(
+            replica_targets, readers, window_s, num_players, read_errors,
+        )
+        for t in producer_threads:
+            t.join(timeout=60.0)
+        frontdoor.flush()
+        ingest_s = time.perf_counter() - ingest_t0
+        writer_wm = int(eng.matches_applied)
+        catchup_t0 = time.perf_counter()
+        try:
+            for _r_srv, reader, _r_wire in replicas:
+                reader.wait_for_watermark(warm_wm + streamed,
+                                          timeout=catchup_timeout_s)
+        except replica_mod.ReplicaError as exc:
+            raise ReplicaGateError(
+                f"catch-up lag blew its bound under concurrent wire "
+                f"ingest: {exc}"
+            ) from exc
+        catchup_s = time.perf_counter() - catchup_t0
+        ingest_stop.set()
+        monitor.join(timeout=10.0)
+        if writer_wm != warm_wm + streamed:
+            raise ReplicaGateError(
+                f"writer settled at watermark {writer_wm}, expected "
+                f"{warm_wm + streamed}; the ingest phase lost matches"
+            )
+
+        # --- the bit-exactness HARD gate: equal watermark, zero diff --
+        w_ratings, w_wm = srv.engine.ratings_snapshot()
+        max_diff = 0.0
+        for r_idx, (r_srv, _reader, _r_wire) in enumerate(replicas):
+            r_ratings, r_wm = r_srv.engine.ratings_snapshot()
+            if r_wm != w_wm:
+                raise ReplicaGateError(
+                    f"replica {r_idx} settled at watermark {r_wm}, "
+                    f"writer at {w_wm}; no equal-watermark comparison "
+                    "is possible"
+                )
+            diff = float(
+                np.abs(np.asarray(w_ratings) - np.asarray(r_ratings)).max()
+            )
+            max_diff = max(max_diff, diff)
+        if max_diff > tol:
+            raise EquivalenceError(max_diff, tol)
+
+        # --- phase C: the fleet, quiet (the scale-out numerator) ------
+        aggregate_queries, aggregate_elapsed, per_replica = (
+            _replica_read_phase(
+                replica_targets, readers, window_s, num_players,
+                read_errors,
+            )
+        )
+        aggregate_qps = aggregate_queries / aggregate_elapsed
+        if read_errors:
+            raise ReplicaGateError(
+                f"{len(read_errors)} wire worker(s) failed during the "
+                f"measured phases: {read_errors[:4]}"
+            )
+        scaleout = aggregate_qps / single_qps if single_qps else 0.0
+        if scaleout < scaleout_min:
+            raise ReplicaGateError(
+                f"aggregate read throughput across {num_replicas} "
+                f"replicas is {aggregate_qps:.0f} q/s vs {single_qps:.0f} "
+                f"q/s on one server ({scaleout:.2f}x < the "
+                f"{scaleout_min:g}x floor); the replica read path is "
+                "structurally slower than the server it mirrors"
+            )
+
+        # --- the zero-recompile HARD gate -----------------------------
+        grew = sentinel.new_compiles()
+        if grew:
+            raise ReplicaGateError(
+                f"steady-state record replay recompiled: {grew}; every "
+                "shipped record is stream-batch shaped, so the bucket "
+                "was compiled at warmup and must stay compiled"
+            )
+
+        records_shipped = sum(r.records_applied for _s, r, _w in replicas)
+        segments = sum(r.segments_fetched for _s, r, _w in replicas)
+        slo_names = [
+            s.name for s in replicas[0][0].obs.slo.slos
+        ]
+        result = {
+            "metric": "arena_replica",
+            "value": round(aggregate_qps, 2),
+            "unit": "replica_queries_per_s",
+            "vs_baseline": None,
+            "params": {
+                "base_matches": base_matches,
+                "stream_batch": stream_batch,
+                "num_players": num_players,
+                "batch_size": batch,
+                "seed": seed,
+                "replicas": num_replicas,
+                "producers": producers,
+                "readers_per_target": readers,
+                "catchup_batches": catchup_batches,
+                "read_window_s": window_s,
+                "scaleout_min": scaleout_min,
+                "inc_ratio_min": inc_ratio_min,
+                "host_cores": os.cpu_count() or 1,
+            },
+            "replica": {
+                "snapshot": {
+                    "full_bytes": full_bytes,
+                    "incremental_bytes": inc_bytes,
+                    "bytes_ratio": round(bytes_ratio, 2),
+                    "full_s": round(full_s, 6),
+                    "full_base_s": round(full_a_s, 6),
+                    "incremental_s": round(inc_s, 6),
+                    "latency_ratio": round(full_s / inc_s, 2) if inc_s
+                    else None,
+                    "churn_matches": churn_matches,
+                    "chain_depth": inc_manifest.get("chain_depth"),
+                    "reuses_base_runs": inc_manifest.get("reuses_base_runs"),
+                    "delta_matches": inc_manifest.get("delta_matches"),
+                },
+                "single_server_queries_per_s": round(single_qps, 2),
+                "aggregate_queries_per_s": round(aggregate_qps, 2),
+                "per_replica_queries": per_replica,
+                "scaleout_ratio": round(scaleout, 3),
+                "reads_during_ingest": during_queries,
+                "catchup": {
+                    "streamed_matches": streamed,
+                    "streamed_batches": producers * catchup_batches,
+                    "ingest_s": round(ingest_s, 6),
+                    "catchup_s": round(catchup_s, 6),
+                    "catchup_bound_s": catchup_timeout_s,
+                    "staleness_peak_matches": int(staleness_peak[0]),
+                    "records_shipped": records_shipped,
+                    "segments_fetched": segments,
+                },
+                "staleness_slo_registered": "replica-staleness" in slo_names,
+                "steady_state_new_compiles": 0,  # sentinel raised otherwise
+            },
+            "equivalence_ok": True,
+            "max_rating_diff": round(max_diff, 6),
+        }
+    finally:
+        for _r_srv, reader, r_wire in replicas:
+            reader.close()
+            r_wire.close()
+            _r_srv.close()
+        wire.close()
+        frontdoor.close()
+        srv.close()
+        if not os.environ.get("ARENA_DEBUG_DIR"):
+            shutil.rmtree(snap_root, ignore_errors=True)
+    return result
+
+
 def main() -> int:
     rc = 0
     mode = os.environ.get("ARENA_BENCH_MODE", "elo")
@@ -1792,6 +2232,7 @@ def main() -> int:
         "serve": (run_serve_benchmark, "queries_per_s"),
         "soak": (run_soak_benchmark, "p99_query_latency_ms"),
         "frontend": (run_frontend_benchmark, "wire_queries_per_s"),
+        "replica": (run_replica_benchmark, "replica_queries_per_s"),
     }
     runner, unit = runners.get(mode, (run_benchmark, "x_vs_naive_baseline"))
     try:
@@ -1853,6 +2294,21 @@ def main() -> int:
         line = json.dumps(
             {
                 "metric": "arena_bench_frontend_gate_failure",
+                "value": -1,
+                "unit": unit,
+                "vs_baseline": None,
+                "error": str(exc),
+                "debug_bundle": _gate_debug_bundle(mode),
+            }
+        )
+        rc = EXIT_EQUIVALENCE_FAILURE
+    except ReplicaGateError as exc:
+        # The read fleet's replication contract broke (snapshot size,
+        # catch-up bound, scale-out floor, recompile): a measured
+        # verdict, never a crash.
+        line = json.dumps(
+            {
+                "metric": "arena_bench_replica_gate_failure",
                 "value": -1,
                 "unit": unit,
                 "vs_baseline": None,

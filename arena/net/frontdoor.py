@@ -64,6 +64,7 @@ Everything here is host-side NumPy + stdlib threading — no jax (the
 jitted work stays behind `ArenaEngine`).
 """
 
+import bisect
 import threading
 import time
 from collections import deque
@@ -89,6 +90,11 @@ SUMMARY_PRODUCER = "coalesced"
 
 # Wait quantum: every blocking loop re-checks worker liveness.
 _WAIT_S = 0.05
+
+# Most applied-log records one /log response may carry. Replicas page:
+# a bounded segment keeps one catch-up response from rendering the
+# whole history into a single JSON body.
+MAX_LOG_SEGMENT_RECORDS = 512
 
 # Part of the observability contract: the sampling profiler
 # (arena/obs/profile.py) maps this thread name to the "dispatcher"
@@ -164,9 +170,17 @@ class FrontDoor:
         # staleness_matches() measures OUR backlog, not history's.
         self._base_applied = engine.matches_applied
         # The deterministic application order, recorded for replay
-        # (tests and the frontend bench's HARD equivalence gate).
+        # (tests and the frontend bench's HARD equivalence gate) and —
+        # since PR 18 — shipped to replicas over `GET /log`. The log
+        # seq of a record is its INDEX in `applied_log` (dense,
+        # gapless: exactly what strict in-order replay needs);
+        # `applied_watermarks[i]` is the engine watermark after record
+        # i is applied, so a replica restored from a snapshot at
+        # watermark W can resume from the record boundary matching W.
         self.record_applied = record_applied
-        self.applied_log = []
+        self.applied_log = []  # guarded_by: _cv
+        self.applied_watermarks = []  # guarded_by: _cv
+        self._log_matches = 0  # guarded_by: _cv  (matches covered by the log)
         if engine._pipeline is None:
             engine.start_pipeline(producer=pipeline_producer)
         self._thread = threading.Thread(
@@ -208,6 +222,71 @@ class FrontDoor:
     def pending_batches(self):
         with self._cv:
             return len(self._buffer) + (1 if self._summary else 0)
+
+    def log_segment(self, after_seq=-1, after_watermark=None,
+                    limit=MAX_LOG_SEGMENT_RECORDS):
+        """Page the applied log for replication: records with log seq
+        > `after_seq` (or, when `after_watermark` is given, the records
+        past the record boundary whose post-apply watermark equals it —
+        how a replica restored from a snapshot at watermark W aligns
+        its cursor without re-shipping history). Returns
+        `(records, next_seq, log_len, base_watermark)` where each
+        record is `(seq, kind, winners, losers, watermark)`.
+
+        Raises ValueError when `after_watermark` does not land on a
+        record boundary (a replica restored from a snapshot taken
+        mid-record cannot replay strictly in sequence order and must
+        fall back to an older boundary snapshot)."""
+        if not self.record_applied:
+            raise FrontDoorError(
+                "applied-log recording is disabled on this front door; "
+                "construct it with record_applied=True to ship the log"
+            )
+        if limit < 1:
+            raise ValueError(f"limit must be >= 1 record, got {limit}")
+        limit = min(int(limit), MAX_LOG_SEGMENT_RECORDS)
+        with self._cv:
+            log_len = len(self.applied_log)
+            if after_watermark is not None:
+                start = self._seq_for_watermark_locked(
+                    int(after_watermark), log_len
+                )
+            else:
+                start = int(after_seq) + 1
+                if start < 0:
+                    raise ValueError(
+                        f"after_seq must be >= -1, got {after_seq}"
+                    )
+            stop = min(log_len, start + limit)
+            records = [
+                (
+                    i,
+                    self.applied_log[i][0],
+                    self.applied_log[i][1],
+                    self.applied_log[i][2],
+                    self.applied_watermarks[i],
+                )
+                for i in range(start, stop)
+            ]
+            return records, stop, log_len, self._base_applied
+
+    def _seq_for_watermark_locked(self, after_watermark, log_len):
+        """Map a watermark onto the log cursor PAST its record
+        boundary. The base watermark (engine state before the log
+        began) maps to seq 0; any other watermark must equal some
+        record's post-apply watermark exactly."""
+        if after_watermark == self._base_applied:
+            return 0
+        idx = bisect.bisect_left(
+            self.applied_watermarks, after_watermark, 0, log_len
+        )
+        if idx >= log_len or self.applied_watermarks[idx] != after_watermark:
+            raise ValueError(
+                f"watermark {after_watermark} is not an applied-log record "
+                f"boundary (base={self._base_applied}, "
+                f"records={log_len}); restore from a boundary snapshot"
+            )
+        return idx + 1
 
     def _raise_if_failed_locked(self):
         if self._error is not None:
@@ -388,7 +467,7 @@ class FrontDoor:
                 self._applying = False
                 self._cv.notify_all()
 
-    def _apply(self, popped):  # deterministic; mutates: summaries_applied, applied_batches, applied_matches, applied_log; schema: applied-log-record@v1
+    def _apply(self, popped):  # deterministic; mutates: summaries_applied, applied_batches, applied_matches, applied_log, applied_watermarks, _log_matches; schema: applied-log-record@v1
         kind, payload = popped
         obs = self._obs()
         if kind == "summary":
@@ -401,8 +480,12 @@ class FrontDoor:
             with self._cv:
                 self.summaries_applied += 1
                 self.applied_matches += int(w.shape[0])
-            if self.record_applied:
-                self.applied_log.append(("summary", w, l))
+                if self.record_applied:
+                    self._log_matches += int(w.shape[0])
+                    self.applied_watermarks.append(
+                        self._base_applied + self._log_matches
+                    )
+                    self.applied_log.append(("summary", w, l))
         else:
             item = payload
             # Adopt the request's context: the apply span (and the
@@ -415,8 +498,12 @@ class FrontDoor:
             with self._cv:
                 self.applied_batches += 1
                 self.applied_matches += int(item.winners.shape[0])
-            if self.record_applied:
-                self.applied_log.append(("batch", item.winners, item.losers))
+                if self.record_applied:
+                    self._log_matches += int(item.winners.shape[0])
+                    self.applied_watermarks.append(
+                        self._base_applied + self._log_matches
+                    )
+                    self.applied_log.append(("batch", item.winners, item.losers))
 
     # --- overload / drain / shutdown ----------------------------------
 

@@ -35,6 +35,10 @@ ENDPOINTS = (
     # The batched read endpoint (PR 16): one POST carrying many
     # leaderboard/player/h2h lookups, every one answered from ONE view.
     "query",
+    # The replication log (PR 18): replicas page the writer's applied
+    # log by sequence number (or align a restored snapshot by
+    # watermark) and replay it strictly in order.
+    "log",
 )
 
 # Default leaderboard page when the query string omits one.
@@ -67,6 +71,14 @@ def _query_int(params, key, default=None):
         ) from None
 
 
+def _query_opt_int(params, key):
+    """An OPTIONAL integer query param: None when absent (unlike
+    `_query_int`, whose None default means required)."""
+    if params.get(key, [None])[0] is None:
+        return None
+    return _query_int(params, key)
+
+
 def parse_path(method, path):
     """Map (method, raw path) onto (endpoint, params) or raise
     `ProtocolError` with the status an unmatched request deserves:
@@ -88,6 +100,9 @@ def parse_path(method, path):
             "offset": _query_int(params, "offset", 0),
             "limit": _query_int(params, "limit", DEFAULT_PAGE_LIMIT),
         }
+        as_of = _query_opt_int(params, "as_of")
+        if as_of is not None:
+            parsed["as_of"] = as_of
     elif route == "player" and len(parts) == 2:
         endpoint, want = "player", "GET"
         try:
@@ -96,6 +111,9 @@ def parse_path(method, path):
             raise ProtocolError(
                 400, f"player id must be an integer, got {parts[1]!r}"
             ) from None
+        as_of = _query_opt_int(params, "as_of")
+        if as_of is not None:
+            parsed["as_of"] = as_of
     elif route == "h2h" and len(parts) == 1:
         endpoint, want = "h2h", "GET"
         parsed = {"a": _query_int(params, "a"), "b": _query_int(params, "b")}
@@ -105,6 +123,17 @@ def parse_path(method, path):
     elif route == "query" and len(parts) == 1:
         endpoint, want = "query", "POST"
         parsed = {}
+    elif route == "log" and len(parts) == 1:
+        endpoint, want = "log", "GET"
+        parsed = {
+            "after_seq": _query_int(params, "after_seq", -1),
+            "after_watermark": _query_opt_int(params, "after_watermark"),
+            "limit": _query_int(params, "limit", 0),
+        }
+        if parsed["after_seq"] < -1:
+            raise ProtocolError(
+                400, f"after_seq must be >= -1, got {parsed['after_seq']}"
+            )
     elif (
         route == "debug"
         and len(parts) == 2

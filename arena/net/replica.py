@@ -1,0 +1,566 @@
+"""Replica catch-up and time travel over the shipped applied log.
+
+ROADMAP item 2's read-fleet piece. A writer with a `FrontDoor`
+(`record_applied=True`) exposes its applied log — the deterministic
+total order — over `GET /log` (see `protocol.parse_path` and
+`server._log_payload`). This module is the consumer side:
+
+1. **`ReplicaReader`** — restores a base snapshot (full or the head of
+   an incremental chain) into its own `ArenaServer`, then tails the
+   writer's log over the existing HTTP tier and replays records
+   STRICTLY in log-sequence order through the synchronous
+   `ArenaEngine.ingest` path. jaxlint v5's `# deterministic` contracts
+   on the apply path are the static statement of why this works: the
+   writer applied the same records in the same order through the same
+   kernels, so the replica's ratings are bit-exact vs the writer at
+   equal watermark (every record's post-apply watermark is
+   cross-checked during replay — a divergence is a raised
+   `ReplicaError`, not a silently forked replica). The replica serves
+   reads through `ArenaHTTPServer(frontdoor=None)` — the read-only
+   skeleton that 503s on /submit — with PR 16's fastpath cache
+   unchanged.
+
+2. **Tail/replay split.** The network fetch (`arena-replica-tail`
+   thread) and the deterministic apply (`arena-replica-replay` thread)
+   are separate so a slow writer round-trip never stalls the replay of
+   already-fetched segments, and the profiler folds the two costs
+   under distinct roles.
+
+3. **Per-replica staleness as an SLO objective.** Every poll records
+   how many matches the replica trails the writer into
+   `arena_replica_staleness_matches` and evaluates the burn-rate
+   engine; `ReplicaReader.start()` registers the `replica-staleness`
+   objective (`slo.replica_staleness_slo`) on the replica's own
+   engine, so `/debug/slo` on the replica is the health surface a
+   fleet controller polls for placement/eviction.
+
+4. **`TimeTravelIndex`** — answers `?as_of=<watermark>` reads by
+   replaying the shipped log to the requested watermark against the
+   nearest retained snapshot (historical views are immutable, so a
+   small bounded cache makes repeats cheap). Works on the writer
+   (log source = the front door) and on replicas (log source = the
+   reader's retained records) alike.
+
+Everything here is host-side stdlib + NumPy; jitted work stays behind
+`ArenaEngine`.
+"""
+
+import bisect
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from arena import serving as serving_mod
+from arena.engine import ArenaEngine
+from arena.net import protocol
+from arena.net.frontdoor import MAX_LOG_SEGMENT_RECORDS
+from arena.obs import slo as slo_mod
+from arena.serving import ServingView
+
+# Part of the observability contract: the sampling profiler
+# (arena/obs/profile.py) maps these names to the "replica-tail" /
+# "replica-replay" roles. Rename here and the role table moves along.
+TAIL_THREAD_NAME = "arena-replica-tail"
+REPLAY_THREAD_NAME = "arena-replica-replay"
+
+# How long the tail sleeps after an empty poll (the writer had nothing
+# new). Small: catch-up lag under live ingest is poll-bounded.
+DEFAULT_POLL_INTERVAL_S = 0.02
+
+# Fetched-but-not-yet-applied segments the tail may buffer ahead of
+# the replay thread before it stops fetching (bounds memory, not
+# correctness — replay order is carried by the records themselves).
+DEFAULT_PENDING_SEGMENTS = 64
+
+# Historical views a TimeTravelIndex retains; one view is a full
+# store clone, so this bounds memory like the serving view does.
+DEFAULT_CACHED_VIEWS = 8
+
+
+class ReplicaError(RuntimeError):
+    """The replica cannot make progress or has DIVERGED from the
+    writer: an out-of-sequence record, a watermark cross-check
+    mismatch, a failed /log fetch, or a dead worker thread."""
+
+
+class SegmentCursor:  # protocol: close
+    """One replica's read position in a writer's applied log, plus the
+    persistent wire connection it pages over.
+
+    The first fetch aligns by watermark (`after_watermark=` — how a
+    reader restored from a snapshot at watermark W seats its cursor
+    without re-shipping history); every later fetch pages by the
+    sequence cursor the previous response returned. The cursor also
+    verifies each page CONTINUES the sequence — a gap at the transport
+    layer is an error here, before any record reaches an engine."""
+
+    def __init__(self, host, port, *, start_watermark=None, timeout=10.0):
+        self._client = protocol.WireClient(host, port, timeout=timeout)
+        self._start_watermark = start_watermark
+        self._aligned = start_watermark is None
+        self.next_seq = 0
+        self.log_len = 0
+        self.base_watermark = None
+        self.writer_watermark = 0
+        self.fetches = 0
+
+    def fetch(self, limit=MAX_LOG_SEGMENT_RECORDS):  # schema: wire-log-segment@v1
+        """One /log page: a list of record dicts in sequence order
+        (possibly empty). Raises ReplicaError on any non-200 answer or
+        a page that does not continue this cursor's sequence."""
+        if not self._aligned:
+            path = (
+                f"/log?after_watermark={int(self._start_watermark)}"
+                f"&limit={int(limit)}"
+            )
+        else:
+            path = f"/log?after_seq={self.next_seq - 1}&limit={int(limit)}"
+        status, doc = self._client.get(path)
+        if status != 200:
+            err = doc.get("error") if isinstance(doc, dict) else doc
+            raise ReplicaError(f"writer /log answered {status}: {err}")
+        records = doc["records"]
+        expect = self.next_seq
+        for rec in records:
+            if not self._aligned:
+                # The aligned page may start anywhere the watermark
+                # mapped to; later pages must continue exactly.
+                expect = rec["seq"]
+                self._aligned = True
+            if rec["seq"] != expect:
+                raise ReplicaError(
+                    f"log page breaks the sequence: expected seq {expect}, "
+                    f"got {rec['seq']}"
+                )
+            expect += 1
+        self._aligned = True
+        if records:
+            self.next_seq = records[-1]["seq"] + 1
+        else:
+            # An empty page still positions the cursor: the writer's
+            # next_seq is where the watermark (or after_seq) mapped to.
+            # Without this, a replica restored exactly at the writer's
+            # head would fall back to seq 0 on its next poll and
+            # re-ship history into the divergence check.
+            self.next_seq = doc["next_seq"]
+        self.log_len = doc["log_len"]
+        self.base_watermark = doc["base_watermark"]
+        self.writer_watermark = doc["watermark"]
+        self.fetches += 1
+        return records
+
+    def close(self):
+        self._client.close()
+
+
+class ReplicaReader:  # protocol: start->close
+    """Catch a read replica up to a writer and keep it caught up.
+
+    Construction optionally restores `snapshot` (full or incremental
+    head) into the replica's `ArenaServer`; `start()` spawns the tail
+    and replay threads; `close()` stops and joins them and closes the
+    wire connection. Replay is strict: records apply in log-sequence
+    order through the deterministic sync ingest path, each record's
+    post-apply watermark is cross-checked against the writer's, and
+    any violation kills the reader with a `ReplicaError` surfaced on
+    the next call — a stopped replica, never a forked one.
+    """
+
+    def __init__(self, server, writer_host, writer_port, *, snapshot=None,
+                 poll_interval_s=DEFAULT_POLL_INTERVAL_S,
+                 segment_limit=MAX_LOG_SEGMENT_RECORDS,
+                 pending_segments=DEFAULT_PENDING_SEGMENTS,
+                 staleness_slo_matches=slo_mod.DEFAULT_REPLICA_STALENESS_MATCHES):
+        self._srv = server
+        self._obs = server.obs
+        if snapshot is not None:
+            server.restore(snapshot)
+        self._eng = server.engine
+        self._poll_interval_s = poll_interval_s
+        self._segment_limit = segment_limit
+        self._pending_segments = pending_segments
+        self._staleness_slo_matches = staleness_slo_matches
+        self._base_watermark = int(self._eng.matches_applied)
+        self._cursor = SegmentCursor(
+            writer_host, writer_port, start_watermark=self._base_watermark
+        )
+        self._cv = threading.Condition()
+        self._pending = deque()  # guarded_by: _cv  (fetched segments)
+        self._closed = False  # guarded_by: _cv
+        self._error = None  # guarded_by: _cv
+        self._applied_seq = -1  # log seq of the last applied record
+        # The first record after watermark alignment anchors the seq
+        # (the writer owns the watermark->seq mapping); its OWN
+        # correctness is still pinned by the record-watermark
+        # cross-check. Every later record must continue exactly.
+        self._anchored = False
+        self._watermark = self._base_watermark
+        self._writer_log_len = None  # guarded_by: _cv  (None until a fetch)
+        # The locally retained shipped log — (seq, kind, winners,
+        # losers, watermark) tuples in apply order. Feeds this
+        # replica's own TimeTravelIndex and the bit-exactness tests.
+        self.records = []
+        self.segments_fetched = 0
+        self.records_applied = 0
+        self._tail = None
+        self._replay = None
+
+    # --- lifecycle ----------------------------------------------------
+
+    def start(self):
+        """Register the staleness SLO objective and spawn the tail and
+        replay threads. Idempotence is not a goal: one reader, one
+        start."""
+        if self._tail is not None:
+            raise ReplicaError("replica reader already started")
+        try:
+            self._obs.slo.add(
+                slo_mod.replica_staleness_slo(self._staleness_slo_matches)
+            )
+        except slo_mod.SLOError:
+            pass  # already registered on this obs (restarted reader)
+        self._tail = threading.Thread(
+            target=self._tail_loop, name=TAIL_THREAD_NAME, daemon=True
+        )
+        self._replay = threading.Thread(
+            target=self._replay_loop, name=REPLAY_THREAD_NAME, daemon=True
+        )
+        self._tail.start()
+        self._replay.start()
+        return self
+
+    def close(self):
+        """Stop both threads, join them, close the wire connection.
+        Safe to call more than once; never raises on a dead worker
+        (the error already surfaced or will via `raise_if_failed`)."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        for thread in (self._tail, self._replay):
+            if thread is not None and thread.is_alive():
+                thread.join(timeout=10.0)
+        self._cursor.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.close()
+
+    # --- status -------------------------------------------------------
+
+    def watermark(self):
+        """Matches applied on the replica engine (== the writer's
+        watermark at the last applied record boundary)."""
+        return self._watermark
+
+    def applied_seq(self):
+        return self._applied_seq
+
+    def staleness_matches(self):
+        """How many matches the replica trails the writer's last
+        observed watermark (0 until the first fetch lands)."""
+        return max(0, self._cursor.writer_watermark - self._watermark)
+
+    def raise_if_failed(self):
+        """Surface a dead worker as an explicit error (the PR 10
+        liveness discipline): a recorded failure re-raises, and a
+        worker that died WITHOUT recording one is still a raise, never
+        a silent hang for whoever is waiting on replica progress."""
+        with self._cv:
+            if self._error is not None:
+                raise ReplicaError(
+                    f"replica reader failed: {self._error!r}"
+                ) from self._error
+            if self._closed:
+                return
+            for thread in (self._tail, self._replay):
+                if thread is not None and not thread.is_alive():
+                    raise ReplicaError(
+                        f"replica worker {thread.name!r} died without "
+                        "recording a failure"
+                    )
+
+    def wait_for_watermark(self, watermark, timeout=30.0):
+        """Block until the replica has applied up to `watermark`.
+        Raises ReplicaError on a reader failure or timeout — catch-up
+        lag is BOUNDED, not best-effort."""
+        deadline = time.monotonic() + timeout
+        while True:
+            self.raise_if_failed()
+            if self._watermark >= watermark:
+                return self._watermark
+            if time.monotonic() > deadline:
+                raise ReplicaError(
+                    f"replica did not reach watermark {watermark} within "
+                    f"{timeout}s (at {self._watermark})"
+                )
+            with self._cv:
+                self._cv.wait(0.01)
+
+    def log_segment(self, after_seq=-1, after_watermark=None,
+                    limit=MAX_LOG_SEGMENT_RECORDS):
+        """The replica's retained shipped log, in `FrontDoor
+        .log_segment` shape — so a `TimeTravelIndex` (and anything
+        else that pages a log) works against writer and replica
+        alike."""
+        with self._cv:
+            log_len = len(self.records)
+            if after_watermark is not None:
+                wm = int(after_watermark)
+                if wm == self._base_watermark:
+                    start = 0
+                else:
+                    marks = [r[4] for r in self.records]
+                    idx = bisect.bisect_left(marks, wm)
+                    if idx >= log_len or marks[idx] != wm:
+                        raise ValueError(
+                            f"watermark {wm} is not a replayed record "
+                            f"boundary on this replica"
+                        )
+                    start = idx + 1
+            else:
+                start = int(after_seq) + 1
+            stop = min(log_len, start + int(limit))
+            # Replayed records keep their WRITER log seqs; index
+            # locally by offset from the first retained record.
+            return (
+                list(self.records[start:stop]),
+                stop,
+                log_len,
+                self._base_watermark,
+            )
+
+    # --- the tail thread (network) ------------------------------------
+
+    def _tail_loop(self):
+        try:
+            while True:
+                with self._cv:
+                    if self._closed:
+                        return
+                    while (
+                        len(self._pending) >= self._pending_segments
+                        and not self._closed
+                    ):
+                        self._cv.wait(0.05)
+                    if self._closed:
+                        return
+                records = self._cursor.fetch(limit=self._segment_limit)
+                self.segments_fetched += 1
+                with self._cv:
+                    self._writer_log_len = self._cursor.log_len
+                    if records:
+                        self._pending.append(records)
+                        self._cv.notify_all()
+                self._observe_staleness()
+                if not records:
+                    time.sleep(self._poll_interval_s)
+        except BaseException as exc:  # noqa: BLE001 — surface on callers
+            with self._cv:
+                self._error = exc
+                self._cv.notify_all()
+
+    def _observe_staleness(self):
+        """One staleness observation per poll + one burn-rate pull:
+        the replica-staleness objective only means something if it is
+        actually EVALUATED on the live engine (the mutation audit
+        pins this — see staleness-slo-never-evaluated)."""
+        lag = float(self.staleness_matches())
+        self._obs.histogram(
+            "arena_replica_staleness_matches", base=1.0
+        ).record(lag)
+        self._obs.gauge("arena_replica_staleness_matches_now").set(lag)
+        self._obs.slo.evaluate()
+
+    # --- the replay thread (deterministic apply) ----------------------
+
+    def _replay_loop(self):
+        try:
+            while True:
+                with self._cv:
+                    while not self._pending and not self._closed:
+                        self._cv.wait(0.05)
+                    if not self._pending and self._closed:
+                        return
+                    segment = self._pending.popleft()
+                    self._cv.notify_all()
+                with self._obs.span("replica.replay"):
+                    self._apply_records(segment)
+                # Publish: reads on this replica see the new state at
+                # the next view refresh (the serving staleness policy),
+                # and the fastpath cache invalidates by view seq.
+                self._srv.refresh_view()
+                with self._cv:
+                    self._cv.notify_all()
+        except BaseException as exc:  # noqa: BLE001 — surface on callers
+            with self._cv:
+                self._error = exc
+                self._cv.notify_all()
+
+    def _apply_records(self, segment):  # deterministic; mutates: _applied_seq, _anchored, _watermark, records, records_applied
+        """Replay one fetched segment STRICTLY in sequence order
+        through the synchronous ingest path. Three checks stand
+        between a bad segment and the engine: the seq must continue
+        the applied sequence exactly (arrival order is NOT apply
+        order), the kind must be known, and the post-apply watermark
+        must equal the writer's recorded one (the bit-exactness
+        cross-check: same records, same order, same kernels)."""
+        for rec in segment:
+            seq = rec["seq"]
+            if self._anchored and seq != self._applied_seq + 1:
+                raise ReplicaError(
+                    f"record out of sequence: expected {self._applied_seq + 1}, "
+                    f"got {seq} — refusing to apply out of order"
+                )
+            self._anchored = True
+            kind = rec["kind"]
+            if kind not in ("batch", "summary"):
+                raise ReplicaError(f"unknown log record kind {kind!r}")
+            w = np.asarray(rec["winners"], np.int32)
+            l = np.asarray(rec["losers"], np.int32)
+            self._eng.ingest(w, l)
+            applied = int(self._eng.matches_applied)
+            if applied != rec["record_watermark"]:
+                raise ReplicaError(
+                    f"watermark diverged at seq {seq}: replica at {applied}, "
+                    f"writer recorded {rec['record_watermark']}"
+                )
+            self._applied_seq = seq
+            self._watermark = applied
+            self.records.append((seq, kind, w, l, applied))
+            self.records_applied += 1
+
+
+class TimeTravelIndex:
+    """`?as_of=<watermark>` reads: the leaderboard as it stood at an
+    earlier point of the stream, answered by replaying the shipped log
+    to the requested watermark against the nearest retained snapshot.
+
+    `log_source` is anything with the `log_segment` shape —
+    `FrontDoor` on a writer, `ReplicaReader` on a replica. Snapshots
+    are registered by path (`add_snapshot`, typically right after
+    `ArenaServer.snapshot()` cuts one); the index reads only manifests
+    until a query actually needs a restore. Answers carry the
+    HISTORICAL watermark (the greatest record boundary <= `as_of`)
+    plus `as_of`/`as_of_watermark` markers; historical state is
+    immutable, so built views are cached (bounded)."""
+
+    def __init__(self, server, log_source, snapshots=(),
+                 cached_views=DEFAULT_CACHED_VIEWS):
+        self._srv = server
+        self._log = log_source
+        self._lock = threading.Lock()
+        self._snapshots = []  # guarded_by: _lock  ((watermark, path) sorted)
+        self._views = {}  # guarded_by: _lock  (as_of -> ServingView)
+        self._cached_views = cached_views
+        for path in snapshots:
+            self.add_snapshot(path)
+
+    def add_snapshot(self, path):  # schema: arena-snapshot@v2
+        """Register one retained snapshot (validating its manifest);
+        returns the watermark it pins."""
+        manifest = serving_mod._read_manifest(path)
+        watermark = int(manifest["num_matches"])
+        with self._lock:
+            bisect.insort(self._snapshots, (watermark, str(path)))
+        return watermark
+
+    def snapshots(self):
+        with self._lock:
+            return list(self._snapshots)
+
+    def leaderboard(self, offset, limit, as_of):  # schema: wire-query-response@v1
+        view = self._view_for(as_of)
+        payload = self._srv._query_parts(
+            view, False, (offset, limit), None, None, 0, staleness=0
+        )
+        payload["as_of"] = as_of
+        payload["as_of_watermark"] = view.watermark
+        return payload
+
+    def player(self, player, as_of):  # schema: wire-query-response@v1
+        view = self._view_for(as_of)
+        payload = self._srv._query_parts(
+            view, False, None, [player], None, 0, staleness=0
+        )
+        payload["as_of"] = as_of
+        payload["as_of_watermark"] = view.watermark
+        return payload
+
+    def _view_for(self, as_of):
+        """The historical view answering `as_of`: nearest retained
+        snapshot at watermark <= as_of, plus a strict-order replay of
+        the shipped log records whose post-apply watermark is <= as_of.
+        404 when no retained snapshot can seed the replay."""
+        as_of = int(as_of)
+        if as_of < 0:
+            raise protocol.ProtocolError(
+                400, f"as_of must be a non-negative watermark, got {as_of}"
+            )
+        with self._lock:
+            view = self._views.get(as_of)
+            if view is not None:
+                return view
+            idx = bisect.bisect_right(self._snapshots, (as_of, chr(0x10FFFF)))
+            if idx == 0:
+                raise protocol.ProtocolError(
+                    404, f"no retained snapshot at or below watermark "
+                    f"{as_of} (oldest: "
+                    f"{self._snapshots[0][0] if self._snapshots else None})"
+                )
+            snap_watermark, snap_path = self._snapshots[idx - 1]
+            view = self._build_view(snap_path, snap_watermark, as_of)
+            self._views[as_of] = view
+            while len(self._views) > self._cached_views:
+                self._views.pop(next(iter(self._views)))
+            return view
+
+    def _build_view(self, snap_path, snap_watermark, as_of):
+        """Restore the snapshot chain into a throwaway engine, replay
+        shipped records up to `as_of`, freeze a `ServingView`."""
+        manifest, arrays = serving_mod.read_snapshot_chain(snap_path)
+        store = self._srv._assemble_store(manifest, arrays)
+        eng = ArenaEngine(
+            manifest["num_players"],
+            k=manifest["k"],
+            scale=manifest["scale"],
+            base=manifest["base"],
+            min_bucket=manifest["min_bucket"],
+            obs=self._srv.obs,
+        )
+        eng.adopt_state(arrays["ratings"], store)
+        cursor_watermark = snap_watermark
+        done = False
+        while not done:
+            try:
+                records, _next, log_len, _base = self._log.log_segment(
+                    after_watermark=cursor_watermark
+                )
+            except ValueError as exc:
+                raise protocol.ProtocolError(
+                    409, f"snapshot watermark {cursor_watermark} does not "
+                    f"align with the shipped log: {exc}"
+                ) from None
+            if not records:
+                break
+            for rec in records:
+                watermark = rec[4] if isinstance(rec, tuple) else rec["record_watermark"]
+                if watermark > as_of:
+                    done = True
+                    break
+                if isinstance(rec, tuple):
+                    _seq, _kind, w, l, _wm = rec
+                else:
+                    w = np.asarray(rec["winners"], np.int32)
+                    l = np.asarray(rec["losers"], np.int32)
+                eng.ingest(w, l)
+                cursor_watermark = watermark
+        ratings, watermark = eng.ratings_snapshot()
+        view = ServingView(
+            ratings, watermark, eng._store.clone(), None, None, seq=0
+        )
+        eng.shutdown()
+        return view

@@ -62,6 +62,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from arena.net import fastpath, protocol
+from arena.net import frontdoor as frontdoor_mod
 
 # Submit responses are 202 (accepted into the total order, applied
 # asynchronously) — the wire mirrors the front door's semantics.
@@ -113,10 +114,14 @@ def _dispatch(wire, endpoint, params, body_raw):
     if endpoint == "stats":
         return 200, None  # body rendered from the registry
     if endpoint == "leaderboard":
+        if "as_of" in params:
+            return 200, _as_of_payload(wire, params)
         return 200, srv.query(
             leaderboard=(params["offset"], params["limit"])
         )
     if endpoint == "player":
+        if "as_of" in params:
+            return 200, _as_of_payload(wire, params)
         return 200, srv.query(players=[params["player"]])
     if endpoint == "h2h":
         return 200, srv.query(pairs=[(params["a"], params["b"])])
@@ -124,6 +129,8 @@ def _dispatch(wire, endpoint, params, body_raw):
         return 200, srv.query_batch(protocol.parse_query_body(body_raw))
     if endpoint == "submit":
         return _submit(wire, body_raw)
+    if endpoint == "log":
+        return 200, _log_payload(wire, params)
     if endpoint == "debug_window":
         return 200, wire.obs.windows.read()
     if endpoint == "debug_slo":
@@ -188,6 +195,66 @@ def _submit(wire, body_raw):  # schema: wire-submit-response@v1
     }
 
 
+def _log_payload(wire, params):  # schema: wire-log-segment@v1
+    """One page of the writer's applied log for replica catch-up.
+    Records ride in log-sequence order; `next_seq` is the cursor the
+    replica passes back as `after_seq`, `log_len` the writer's current
+    log length (the replica's lag in records is `log_len - next_seq`),
+    and `base_watermark` the engine watermark the log started at."""
+    frontdoor = wire.frontdoor
+    if frontdoor is None:
+        raise protocol.ProtocolError(
+            503, "this server has no front door (read-only replicas "
+            "ship no log)"
+        )
+    limit = params["limit"]
+    if limit <= 0:
+        limit = frontdoor_mod.MAX_LOG_SEGMENT_RECORDS
+    try:
+        records, next_seq, log_len, base_watermark = frontdoor.log_segment(
+            after_seq=params["after_seq"],
+            after_watermark=params["after_watermark"],
+            limit=limit,
+        )
+    except frontdoor_mod.FrontDoorError as exc:
+        raise protocol.ProtocolError(503, str(exc)) from None
+    except ValueError as exc:
+        # A watermark that is not a record boundary: the replica must
+        # re-seat its cursor — a conflict, not a malformed request.
+        raise protocol.ProtocolError(409, str(exc)) from None
+    return {
+        "records": [
+            {
+                "seq": seq,
+                "kind": kind,
+                "winners": w.tolist(),
+                "losers": l.tolist(),
+                "record_watermark": wm,
+            }
+            for seq, kind, w, l, wm in records
+        ],
+        "next_seq": next_seq,
+        "log_len": log_len,
+        "base_watermark": base_watermark,
+    }
+
+
+def _as_of_payload(wire, params):
+    """Time-travel reads: `?as_of=<watermark>` answered by the
+    configured `TimeTravelIndex` (nearest retained snapshot + shipped
+    log replay), not the live view. The payload carries the HISTORICAL
+    watermark, so the envelope is honest about which state answered."""
+    index = wire.time_travel
+    if index is None:
+        raise protocol.ProtocolError(
+            503, "time travel is not configured on this server "
+            "(no snapshot + log index)"
+        )
+    if "player" in params:
+        return index.player(params["player"], params["as_of"])
+    return index.leaderboard(params["offset"], params["limit"], params["as_of"])
+
+
 class ArenaHTTPServer:  # protocol: start->close
     """The wire tier: one front end over one `ArenaServer` (+ optionally
     one `FrontDoor` for the submit path; without one the server is a
@@ -206,9 +273,14 @@ class ArenaHTTPServer:  # protocol: start->close
                  fastpath_reads=True,
                  cache_capacity=fastpath.DEFAULT_CACHE_CAPACITY,
                  prerender_pages=fastpath.DEFAULT_PRERENDER_PAGES,
-                 submit_workers=fastpath.DEFAULT_SUBMIT_WORKERS):
+                 submit_workers=fastpath.DEFAULT_SUBMIT_WORKERS,
+                 time_travel=None):
         self.server = server
         self.frontdoor = frontdoor
+        # Optional `arena.net.replica.TimeTravelIndex` (duck-typed:
+        # anything with leaderboard/player as-of renderers); without
+        # one, `?as_of=` reads answer 503.
+        self.time_travel = time_travel
         self.obs = server.obs
         self.cache = (
             fastpath.ResponseCache(self.obs, capacity=cache_capacity)
@@ -277,6 +349,7 @@ class ArenaHTTPServer:  # protocol: start->close
                 if (
                     self.cache is not None
                     and endpoint in fastpath.CACHEABLE_ENDPOINTS
+                    and "as_of" not in params
                 ):
                     status, head, watermark = fastpath.serve_cached(
                         self, endpoint, params

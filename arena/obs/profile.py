@@ -50,6 +50,8 @@ ROLE_PATTERNS = (
     ("arena-wire-server", "http-accept"),
     ("arena-wire-eventloop", "http-eventloop"),  # the fast read path
     ("arena-wire-submit-", "http-worker"),  # the event loop's submit pool
+    ("arena-replica-tail", "replica-tail"),  # log fetch over the wire
+    ("arena-replica-replay", "replica-replay"),  # strict-seq apply
     ("Thread-", "http-worker"),  # stdlib ThreadingHTTPServer workers
     ("arena-obs-window", "window"),
     ("arena-obs-profiler", "profiler"),

@@ -192,6 +192,31 @@ def default_slos():
     ]
 
 
+# Default replica-staleness threshold: how many matches a replica may
+# trail the writer before a staleness observation burns error budget.
+# Generous like the stock latency SLO — it pages on a stuck tail, not
+# on one slow poll.
+DEFAULT_REPLICA_STALENESS_MATCHES = 10_000
+
+
+def replica_staleness_slo(threshold_matches=DEFAULT_REPLICA_STALENESS_MATCHES,
+                          target=0.99):
+    """Per-replica staleness as a burn-rate objective: 99% of the
+    replica's staleness observations (one per catch-up poll, recorded
+    into `arena_replica_staleness_matches`) must be within
+    `threshold_matches` of the writer. The latency-SLO math is
+    generic over any histogram — here the "latency" is a lag measured
+    in matches, not seconds. Registered by `ReplicaReader.start()` via
+    `SLOEngine.add`, so it appears on /debug/slo only where a replica
+    actually runs — the health surface a fleet controller polls."""
+    return SLO(
+        "replica-staleness",
+        target=target,
+        latency=Selector("arena_replica_staleness_matches"),
+        threshold_s=float(threshold_matches),
+    )
+
+
 class SLOEngine:
     """Evaluates a set of SLOs against one `SlidingWindow`, tracking
     per-objective ok/firing state and posting edge-triggered
@@ -209,6 +234,20 @@ class SLOEngine:
         self._state = {s.name: "ok" for s in self.slos}  # guarded_by: _lock
         self._fired = {s.name: 0 for s in self.slos}  # guarded_by: _lock
         self._firing_log = []  # guarded_by: _lock (bounded, newest last)
+        self.evaluations = 0  # guarded_by: _lock  (pulls, ever)
+
+    def add(self, slo):
+        """Register one more objective on a LIVE engine — how a
+        component that exists only in some deployments (a replica's
+        staleness objective) joins the burn-rate loop without the
+        stock list carrying it everywhere. Duplicate names are a
+        config error, same as at construction."""
+        with self._lock:
+            if any(s.name == slo.name for s in self.slos):
+                raise SLOError(f"duplicate SLO name: {slo.name!r}")
+            self.slos.append(slo)
+            self._state[slo.name] = "ok"
+            self._fired[slo.name] = 0
 
     def _exemplar_for(self, slo):
         """The trace-id exemplar of the offending bucket: the p99
@@ -236,6 +275,7 @@ class SLOEngine:
         objectives = {}
         transitions = []
         with self._lock:
+            self.evaluations += 1
             for slo in self.slos:
                 k = slo.fast_intervals
                 if k not in fast_cache:
@@ -322,6 +362,10 @@ class NullSLOEngine:
 
     enabled = False
     slos = ()
+    evaluations = 0
+
+    def add(self, slo):
+        return None
 
     def evaluate(self):
         return {"objectives": {}, "alerts_active": 0,

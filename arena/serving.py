@@ -56,6 +56,7 @@ the `adopt_state` device boundary (the jaxlint host-path discipline).
 import hashlib
 import json
 import math
+import os
 import pathlib
 import threading
 import time
@@ -69,10 +70,24 @@ from arena.ingest import MergeableCSR
 from arena.obs import Observability
 
 SNAPSHOT_MAGIC = b"ARENASNP"
-SNAPSHOT_VERSION = 1
+# v2 (PR 18): incremental snapshots. A snapshot is now either
+# kind="full" (the v1 shape, every array materialized) or
+# kind="incremental" (cut against a named base): the match log ships
+# only the rows past the base's watermark (`delta_winners` /
+# `delta_losers`), the immutable compacted runs (`keys`/`pos`) are
+# SKIPPED entirely when the store's compaction count is unchanged
+# since the base (the LSM unlock: main runs are rewritten only by a
+# compaction), and the manifest carries a chain link — the base's
+# checksum, watermark, and compaction count — that restore validates
+# hop by hop back to a full snapshot.
+SNAPSHOT_VERSION = 2
 MANIFEST_NAME = "manifest.json"
 ARRAYS_NAME = "arrays.bin"
 _HEADER_BYTES = len(SNAPSHOT_MAGIC) + 4  # magic + uint32 version
+
+# Longest base chain restore will walk before declaring a cycle/runaway
+# (each hop is one manifest+arrays read; operational bound, not RAM).
+MAX_CHAIN_DEPTH = 1024
 
 # Raw-array dtypes a snapshot may carry. int32 everywhere except the
 # ratings vector; anything else in a manifest is a corrupt/foreign file.
@@ -93,7 +108,7 @@ class SnapshotError(RuntimeError):
     leaves a half-restored server."""
 
 
-def _array_entry(name, arr, offset):  # schema: arena-snapshot@v1
+def _array_entry(name, arr, offset):  # schema: arena-snapshot@v2
     return {
         "name": name,
         "dtype": arr.dtype.name,
@@ -102,8 +117,45 @@ def _array_entry(name, arr, offset):  # schema: arena-snapshot@v1
     }
 
 
+def _check_base_compatible(base_manifest, *, num_players, k, scale, base,
+                           min_bucket, store_state):  # schema: incremental-manifest@v1
+    """An increment may only be cut against a base describing the SAME
+    arena (players, rating hyperparameters, store tuning) at an
+    earlier-or-equal point of the SAME stream. Raises SnapshotError —
+    the write-side reject — before any bytes hit disk."""
+    pairs = (
+        ("num_players", num_players),
+        ("k", k),
+        ("scale", scale),
+        ("base", base),
+        ("min_bucket", min_bucket),
+        ("compact_threshold", int(store_state["compact_threshold"])),
+        ("size_ratio", int(store_state["size_ratio"])),
+    )
+    for field, ours in pairs:
+        theirs = base_manifest.get(field)
+        if theirs != ours:
+            raise SnapshotError(
+                f"incremental base mismatch on {field!r}: base snapshot "
+                f"has {theirs!r}, live state has {ours!r}"
+            )
+    base_n = int(base_manifest.get("num_matches"))
+    if base_n > int(store_state["num_matches"]):
+        raise SnapshotError(
+            f"incremental base is AHEAD of the live state: base holds "
+            f"{base_n} matches, live state {int(store_state['num_matches'])}"
+        )
+    if int(base_manifest.get("compactions")) > int(store_state["compactions"]):
+        raise SnapshotError(
+            f"incremental base counts {int(base_manifest.get('compactions'))} "
+            f"compactions, live state only {int(store_state['compactions'])} "
+            "— not the same stream"
+        )
+
+
 def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
-                   store_state, ratings, queue):  # deterministic; schema: arena-snapshot@v1
+                   store_state, ratings, queue, base_manifest=None,
+                   base_ref=None):  # deterministic; schema: arena-snapshot@v2
     """Write one snapshot directory: arrays.bin + manifest.json.
 
     `store_state` is `MergeableCSR.export_state()` output; `ratings` a
@@ -113,9 +165,17 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
     The binary is written first and the manifest last (atomic rename),
     so a torn write leaves no manifest — and a manifest always
     describes complete bytes.
+
+    With `base_manifest` (+ `base_ref`, the path of that base RELATIVE
+    to this snapshot's directory, recorded verbatim in the manifest)
+    the snapshot is cut INCREMENTALLY: the match log carries only the
+    rows past the base's watermark, and the compacted main runs are
+    skipped entirely when no compaction has happened since the base.
+    The manifest's counts (`num_matches`, …) always describe the FULL
+    assembled state, so an increment's manifest reads like the full
+    snapshot it reconstructs to.
     """
     path = pathlib.Path(path)
-    path.mkdir(parents=True, exist_ok=True)
     queue_lengths = np.array([int(w.shape[0]) for w, _l in queue], np.int32)
     queue_w = (
         np.concatenate([w for w, _l in queue]).astype(np.int32)
@@ -125,14 +185,53 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
         np.concatenate([l for _w, l in queue]).astype(np.int32)
         if queue else np.empty(0, np.int32)
     )
+    empty = np.empty(0, np.int32)
+    if base_manifest is not None:
+        if not base_ref or not isinstance(base_ref, str):
+            raise SnapshotError(
+                f"incremental snapshot needs a base_ref path, got {base_ref!r}"
+            )
+        _check_base_compatible(
+            base_manifest, num_players=num_players, k=k, scale=scale,
+            base=base, min_bucket=min_bucket, store_state=store_state,
+        )
+        base_n = int(base_manifest["num_matches"])
+        reuses_base_runs = (
+            int(store_state["compactions"]) == int(base_manifest["compactions"])
+        )
+        kind = "incremental"
+        keys_arr = empty if reuses_base_runs else store_state["keys"]
+        pos_arr = empty if reuses_base_runs else store_state["pos"]
+        winners_arr, losers_arr = empty, empty
+        delta_w = np.ascontiguousarray(store_state["winners"][base_n:])
+        delta_l = np.ascontiguousarray(store_state["losers"][base_n:])
+        chain_depth = int(base_manifest.get("chain_depth", 0)) + 1
+        base_checksum = base_manifest["checksum_sha256"]
+        base_compactions = int(base_manifest["compactions"])
+    else:
+        kind = "full"
+        base_n = 0
+        reuses_base_runs = False
+        keys_arr, pos_arr = store_state["keys"], store_state["pos"]
+        winners_arr, losers_arr = store_state["winners"], store_state["losers"]
+        delta_w, delta_l = empty, empty
+        chain_depth = 0
+        base_ref = None
+        base_checksum = None
+        base_compactions = 0
+    # Directory creation waits until the base checks above pass: a
+    # rejected increment leaves NOTHING on disk, not even an empty dir.
+    path.mkdir(parents=True, exist_ok=True)
     arrays = [
-        ("keys", store_state["keys"]),
-        ("pos", store_state["pos"]),
+        ("keys", keys_arr),
+        ("pos", pos_arr),
         ("tail_keys", store_state["tail_keys"]),
         ("tail_pos", store_state["tail_pos"]),
         ("tail_run_lengths", store_state["tail_run_lengths"]),
-        ("winners", store_state["winners"]),
-        ("losers", store_state["losers"]),
+        ("winners", winners_arr),
+        ("losers", losers_arr),
+        ("delta_winners", delta_w),
+        ("delta_losers", delta_l),
         ("ratings", np.asarray(ratings, np.float32)),
         ("queue_lengths", queue_lengths),
         ("queue_winners", queue_w),
@@ -151,6 +250,7 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
     manifest = {
         "magic": SNAPSHOT_MAGIC.decode("ascii"),
         "version": SNAPSHOT_VERSION,
+        "kind": kind,
         "num_players": num_players,
         "num_matches": int(store_state["num_matches"]),
         "compactions": int(store_state["compactions"]),
@@ -162,6 +262,13 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
         "min_bucket": min_bucket,
         "queue_batches": int(queue_lengths.size),
         "queue_matches": int(queue_lengths.sum()),
+        "base_snapshot": base_ref,
+        "base_checksum_sha256": base_checksum,
+        "base_num_matches": base_n,
+        "base_compactions": base_compactions,
+        "delta_matches": int(delta_w.size),
+        "reuses_base_runs": reuses_base_runs,
+        "chain_depth": chain_depth,
         "bin_bytes": len(blob),
         "checksum_sha256": hashlib.sha256(blob).hexdigest(),
         "arrays": table,
@@ -172,20 +279,14 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
     return manifest
 
 
-def read_snapshot(path):  # deterministic; schema: arena-snapshot@v1
-    """Validate and load one snapshot directory.
-
-    Returns `(manifest, arrays)` with every array materialized as an
-    independent ndarray. Raises `SnapshotError` — naming expected vs
-    found — on a missing piece, a foreign magic, a version this loader
-    does not speak, a checksum/byte-length mismatch (truncation or
-    corruption), an array table pointing outside the bytes, or counts
-    that disagree with the arrays. Loading mutates nothing: callers
-    install the result only after this returns.
-    """
+def _read_manifest(path):  # deterministic; schema: arena-snapshot@v2
+    """Load and gate one snapshot manifest (magic + version only —
+    the cheap checks that do not need the array bytes). Cutting an
+    increment reads its base through here without paying for the
+    base's arrays; `read_snapshot` layers the full validation on
+    top."""
     path = pathlib.Path(path)
     man_path = path / MANIFEST_NAME
-    bin_path = path / ARRAYS_NAME
     try:
         manifest = json.loads(man_path.read_text())
     except FileNotFoundError:
@@ -203,6 +304,27 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v1
             f"unsupported snapshot version: expected {SNAPSHOT_VERSION}, "
             f"found {found_version}"
         )
+    return manifest
+
+
+def read_snapshot(path):  # deterministic; schema: arena-snapshot@v2
+    """Validate and load one snapshot directory.
+
+    Returns `(manifest, arrays)` with every array materialized as an
+    independent ndarray. Raises `SnapshotError` — naming expected vs
+    found — on a missing piece, a foreign magic, a version this loader
+    does not speak, a checksum/byte-length mismatch (truncation or
+    corruption), an array table pointing outside the bytes, or counts
+    that disagree with the arrays. Loading mutates nothing: callers
+    install the result only after this returns.
+
+    An incremental snapshot validates as ONE LINK: its own bytes,
+    checksum, and delta counts. Use `read_snapshot_chain` to resolve
+    it against its base chain into full assembled state.
+    """
+    path = pathlib.Path(path)
+    bin_path = path / ARRAYS_NAME
+    manifest = _read_manifest(path)
     try:
         blob = bin_path.read_bytes()
     except FileNotFoundError:
@@ -235,7 +357,8 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v1
         )
     for field in (
         "num_players", "num_matches", "compactions", "compact_threshold",
-        "size_ratio", "queue_batches", "queue_matches",
+        "size_ratio", "queue_batches", "queue_matches", "base_num_matches",
+        "base_compactions", "delta_matches", "chain_depth",
     ):
         value = manifest.get(field)
         if not isinstance(value, int) or isinstance(value, bool) or value < 0:
@@ -249,6 +372,33 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v1
             raise SnapshotError(
                 f"manifest field {field!r} must be numeric, found {value!r}"
             )
+    kind = manifest.get("kind")
+    if kind not in ("full", "incremental"):
+        raise SnapshotError(
+            f"manifest field 'kind' must be 'full' or 'incremental', "
+            f"found {kind!r}"
+        )
+    if kind == "incremental":
+        if not isinstance(manifest.get("base_snapshot"), str) or not manifest.get("base_snapshot"):
+            raise SnapshotError(
+                f"incremental manifest needs a 'base_snapshot' path, "
+                f"found {manifest.get('base_snapshot')!r}"
+            )
+        if not isinstance(manifest.get("base_checksum_sha256"), str):
+            raise SnapshotError(
+                f"incremental manifest needs a 'base_checksum_sha256', "
+                f"found {manifest.get('base_checksum_sha256')!r}"
+            )
+        if manifest.get("chain_depth") < 1:
+            raise SnapshotError(
+                "incremental manifest must sit at chain_depth >= 1, "
+                f"found {manifest.get('chain_depth')!r}"
+            )
+    elif manifest.get("base_snapshot") is not None:
+        raise SnapshotError(
+            f"full snapshot must not name a base, found "
+            f"{manifest.get('base_snapshot')!r}"
+        )
     arrays = {}
     for entry in manifest.get("arrays", []):
         try:
@@ -276,14 +426,39 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v1
         ).copy()
     required = {
         "keys", "pos", "tail_keys", "tail_pos", "tail_run_lengths",
-        "winners", "losers", "ratings", "queue_lengths", "queue_winners",
-        "queue_losers",
+        "winners", "losers", "delta_winners", "delta_losers", "ratings",
+        "queue_lengths", "queue_winners", "queue_losers",
     }
     missing = required - set(arrays)
     if missing:
         raise SnapshotError(f"snapshot is missing arrays: {sorted(missing)}")
     n = manifest.get("num_matches")
-    if arrays["winners"].size != n or arrays["losers"].size != n:
+    if kind == "incremental":
+        d = manifest.get("delta_matches")
+        if arrays["delta_winners"].size != d or arrays["delta_losers"].size != d:
+            raise SnapshotError(
+                f"incremental match-log delta holds "
+                f"{arrays['delta_winners'].size}/"
+                f"{arrays['delta_losers'].size} matches, manifest promises {d}"
+            )
+        if manifest.get("base_num_matches") + d != n:
+            raise SnapshotError(
+                f"incremental counts disagree: base {manifest.get('base_num_matches')} "
+                f"+ delta {d} != total {n}"
+            )
+        if arrays["winners"].size or arrays["losers"].size:
+            raise SnapshotError(
+                "incremental snapshot must ship the match log as deltas "
+                f"only, found {arrays['winners'].size} full rows"
+            )
+        if manifest.get("reuses_base_runs") and (
+            arrays["keys"].size or arrays["pos"].size
+        ):
+            raise SnapshotError(
+                "increment claims to reuse the base's compacted runs but "
+                f"ships {arrays['keys'].size} run entries of its own"
+            )
+    elif arrays["winners"].size != n or arrays["losers"].size != n:
         raise SnapshotError(
             f"match log holds {arrays['winners'].size}/"
             f"{arrays['losers'].size} matches, manifest promises {n}"
@@ -306,6 +481,103 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v1
             f"{int(arrays['queue_lengths'].sum())}, manifest promises {qm}"
         )
     return manifest, arrays
+
+
+def _validate_chain_link(child, base_manifest, base_dir):  # deterministic; schema: incremental-manifest@v1
+    """Chain integrity: an increment must resolve against EXACTLY the
+    base it was cut from. The link is pinned three ways — the base's
+    arrays checksum (content identity), its watermark, and its
+    compaction count — so swapping a self-consistent but different
+    snapshot into the base slot is a reject, not a silently forked
+    replica."""
+    if base_manifest.get("checksum_sha256") != child.get("base_checksum_sha256"):
+        raise SnapshotError(
+            f"snapshot chain broken at {base_dir}: increment was cut "
+            f"against base arrays {child.get('base_checksum_sha256')}, "
+            f"base holds {base_manifest.get('checksum_sha256')}"
+        )
+    if int(base_manifest.get("num_matches")) != int(child.get("base_num_matches")):
+        raise SnapshotError(
+            f"snapshot chain broken at {base_dir}: increment expects the "
+            f"base at watermark {child.get('base_num_matches')}, base "
+            f"holds {base_manifest.get('num_matches')} matches"
+        )
+    if int(base_manifest.get("compactions")) != int(child.get("base_compactions")):
+        raise SnapshotError(
+            f"snapshot chain broken at {base_dir}: increment expects "
+            f"{child.get('base_compactions')} compactions at the base, "
+            f"base counts {base_manifest.get('compactions')}"
+        )
+    if int(child.get("chain_depth")) != int(base_manifest.get("chain_depth")) + 1:
+        raise SnapshotError(
+            f"snapshot chain broken at {base_dir}: increment sits at "
+            f"chain_depth {child.get('chain_depth')} over a base at "
+            f"depth {base_manifest.get('chain_depth')}"
+        )
+
+
+def read_snapshot_chain(path):  # deterministic; schema: arena-snapshot@v2
+    """Resolve a snapshot — full or the head of an incremental chain —
+    into fully materialized state.
+
+    Walks `base_snapshot` links (each relative to the directory that
+    names it) back to a full snapshot, validating every directory with
+    `read_snapshot` and every LINK with `_validate_chain_link`, then
+    assembles oldest-first: the match log is the base's rows plus each
+    increment's delta rows in chain order; the compacted runs come
+    from the NEWEST link that shipped them; the delta tail, ratings,
+    and spilled queue come from the head (they describe final state).
+    Returns `(head_manifest, arrays)` in exactly `read_snapshot`'s
+    full-snapshot shape — restore cannot tell the difference, which is
+    the crash-restart property test's bit-exactness claim.
+    """
+    head_dir = pathlib.Path(path)
+    head_manifest, head_arrays = read_snapshot(head_dir)
+    links = [(head_manifest, head_arrays, head_dir)]
+    seen = {head_dir.resolve()}
+    manifest, cur = head_manifest, head_dir
+    while manifest.get("kind") == "incremental":
+        if len(links) > MAX_CHAIN_DEPTH:
+            raise SnapshotError(
+                f"snapshot chain exceeds {MAX_CHAIN_DEPTH} links at {cur}"
+            )
+        base_dir = cur / manifest["base_snapshot"]
+        resolved = base_dir.resolve()
+        if resolved in seen:
+            raise SnapshotError(f"snapshot chain cycles through {base_dir}")
+        seen.add(resolved)
+        base_manifest, base_arrays = read_snapshot(base_dir)
+        _validate_chain_link(manifest, base_manifest, base_dir)
+        links.append((base_manifest, base_arrays, base_dir))
+        manifest, cur = base_manifest, base_dir
+    links.reverse()  # oldest (the full base) first
+    merged = dict(links[0][1])
+    for link_manifest, link_arrays, _dir in links[1:]:
+        merged["winners"] = np.concatenate(
+            [merged["winners"], link_arrays["delta_winners"]]
+        )
+        merged["losers"] = np.concatenate(
+            [merged["losers"], link_arrays["delta_losers"]]
+        )
+        if not link_manifest.get("reuses_base_runs"):
+            merged["keys"] = link_arrays["keys"]
+            merged["pos"] = link_arrays["pos"]
+        merged["tail_keys"] = link_arrays["tail_keys"]
+        merged["tail_pos"] = link_arrays["tail_pos"]
+        merged["tail_run_lengths"] = link_arrays["tail_run_lengths"]
+        merged["ratings"] = link_arrays["ratings"]
+        merged["queue_lengths"] = link_arrays["queue_lengths"]
+        merged["queue_winners"] = link_arrays["queue_winners"]
+        merged["queue_losers"] = link_arrays["queue_losers"]
+    merged["delta_winners"] = np.empty(0, np.int32)
+    merged["delta_losers"] = np.empty(0, np.int32)
+    n = head_manifest.get("num_matches")
+    if merged["winners"].size != n or merged["losers"].size != n:
+        raise SnapshotError(
+            f"assembled chain holds {merged['winners'].size}/"
+            f"{merged['losers'].size} matches, head manifest promises {n}"
+        )
+    return head_manifest, merged
 
 
 class ServingView:
@@ -822,7 +1094,7 @@ class ArenaServer:  # protocol: close
 
     # --- snapshot / restore ------------------------------------------
 
-    def snapshot(self, path, spill=False):  # schema: arena-snapshot@v1
+    def snapshot(self, path, spill=False, base=None):  # schema: arena-snapshot@v2
         """Spill the engine to a durable snapshot directory.
 
         Default: the async pipeline (if any) is DRAINED first
@@ -832,7 +1104,21 @@ class ArenaServer:  # protocol: close
         snapshot (the restart-mid-stream form; the pipeline restarts
         lazily on the next ingest_async). Either way ratings and
         match store agree exactly at write time.
+
+        `base=<path of an existing snapshot>` cuts an INCREMENTAL
+        snapshot against it: only the match rows past the base's
+        watermark, the delta tail, ratings, and (only if a compaction
+        rewrote them) the main runs are spilled, with a validated
+        manifest chain back to the base. The base may itself be an
+        increment — chains restore transitively.
         """
+        base_manifest = None
+        base_ref = None
+        if base is not None:
+            base_manifest = _read_manifest(base)
+            base_ref = os.path.relpath(
+                pathlib.Path(base).resolve(), start=pathlib.Path(path).resolve()
+            )
         with self.obs.span("serve.snapshot"), self._lock:
             eng = self.engine
             if spill:
@@ -875,26 +1161,30 @@ class ArenaServer:  # protocol: close
                 store_state=state,
                 ratings=ratings,
                 queue=queue,
+                base_manifest=base_manifest,
+                base_ref=base_ref,
             )
             self._c_snapshots.inc()
             return manifest
 
-    def restore(self, path):  # schema: arena-snapshot@v1
-        """Reload a snapshot and resume mid-stream.
+    def restore(self, path):  # schema: arena-snapshot@v2
+        """Reload a snapshot — full or incremental head — and resume
+        mid-stream.
 
         Validation and assembly happen on fresh objects FIRST; the
         live engine is swapped only after everything checked out, so
-        a corrupt snapshot leaves the server exactly as it was
-        (`SnapshotError` names expected vs found). While the restore
-        is in progress, concurrent queries serve the last complete
-        view with `stale=True`. Spilled queue batches from the
-        snapshot are resubmitted synchronously, FIFO — after restore
-        the ratings equal an uninterrupted run over the same stream.
+        a corrupt snapshot (or a broken base chain) leaves the server
+        exactly as it was (`SnapshotError` names expected vs found).
+        While the restore is in progress, concurrent queries serve the
+        last complete view with `stale=True`. Spilled queue batches
+        from the snapshot are resubmitted synchronously, FIFO — after
+        restore the ratings equal an uninterrupted run over the same
+        stream.
         """
         self._restoring = True
         try:
             with self.obs.span("serve.restore"):
-                manifest, arrays = read_snapshot(path)
+                manifest, arrays = read_snapshot_chain(path)
                 store = self._assemble_store(manifest, arrays)
                 eng = ArenaEngine(
                     manifest["num_players"],
@@ -922,7 +1212,7 @@ class ArenaServer:  # protocol: close
         self.refresh_view()
         return manifest
 
-    def _assemble_store(self, manifest, arrays):  # schema: arena-snapshot@v1
+    def _assemble_store(self, manifest, arrays):  # schema: arena-snapshot@v2
         """`MergeableCSR.from_state` with its ValueErrors upgraded to
         the snapshot-reject contract (distinct error, nothing
         installed). The delta tail is restored AS RUNS — dropping it
@@ -956,7 +1246,7 @@ class ArenaServer:  # protocol: close
         self.engine.shutdown()
 
 
-def _split_queue(arrays):  # schema: arena-snapshot@v1
+def _split_queue(arrays):  # schema: arena-snapshot@v2
     lengths = arrays["queue_lengths"]
     if not lengths.size:
         return []
@@ -974,8 +1264,9 @@ def _elo_win_prob(r_a, r_b, scale):  # deterministic
 
 
 def restore_server(path, **server_kwargs):
-    """Cold start: a fresh `ArenaServer` restored from a snapshot."""
-    manifest, _arrays = read_snapshot(path)
+    """Cold start: a fresh `ArenaServer` restored from a snapshot
+    (or the head of an incremental chain)."""
+    manifest = _read_manifest(path)
     srv = ArenaServer(num_players=manifest["num_players"], **server_kwargs)
     srv.restore(path)
     return srv

@@ -820,6 +820,42 @@ MUTATIONS = (
         "test_two_hop_closure_is_inside_the_boundary (apply -> _stage "
         "-> _commit must lint clean)",
     ),
+    (
+        "replica-applies-arrival-order-not-sequence-order",
+        "arena/net/replica.py",
+        "            if self._anchored and seq != self._applied_seq + 1:",
+        "            if False:  # trust arrival order",
+        "strict sequence order is the whole bit-exactness argument: a "
+        "replica that applies whatever order segments arrive in forks "
+        "silently from the writer under any reordering — killed by "
+        "test_replica_refuses_out_of_sequence_and_diverged_records (a "
+        "gapped seq must raise ReplicaError before touching the "
+        "engine)",
+    ),
+    (
+        "incremental-manifest-skips-base-chain-validation",
+        "arena/serving.py",
+        '    if base_manifest.get("checksum_sha256") != '
+        'child.get("base_checksum_sha256"):',
+        '    if False:  # any base with matching counts will do',
+        "an increment must resolve against EXACTLY the base it was cut "
+        "from (content identity, not counts); skipping the checksum "
+        "link lets a self-consistent impostor base assemble a silently "
+        "forked state — killed by "
+        "test_restore_rejects_swapped_or_tampered_base_chain (a "
+        "same-count different-stream base must be a named reject)",
+    ),
+    (
+        "staleness-slo-never-evaluated",
+        "arena/net/replica.py",
+        "        self._obs.slo.evaluate()",
+        "        pass  # objective registered; burn-rate pull skipped",
+        "a registered-but-never-evaluated objective is a dead dashboard "
+        "row: the replica reports healthy staleness forever because "
+        "nobody pulls the burn rate — killed by "
+        "test_replica_staleness_slo_and_profiler_roles (the engine's "
+        "evaluations counter must advance while the reader tails)",
+    ),
 )
 
 

@@ -249,10 +249,10 @@ def test_seeded_array_reorder_without_bump_is_flagged():
     """Swap two entries of the arrays.bin table: offsets shift, every
     deployed reader slices garbage — flagged without a bump."""
     src = SERVING.read_text().replace(
-        '        ("winners", store_state["winners"]),\n'
-        '        ("losers", store_state["losers"]),',
-        '        ("losers", store_state["losers"]),\n'
-        '        ("winners", store_state["winners"]),',
+        '        ("winners", winners_arr),\n'
+        '        ("losers", losers_arr),',
+        '        ("losers", losers_arr),\n'
+        '        ("winners", winners_arr),',
     )
     assert src != SERVING.read_text()
     found = _lint_serving(src)
@@ -267,7 +267,7 @@ def test_version_bump_suppresses_schema_drift():
     src = SERVING.read_text().replace(
         '"bin_bytes": len(blob),',
         '"bin_bytes": len(blob),\n        "spare_field": 0,',
-    ).replace("SNAPSHOT_VERSION = 1", "SNAPSHOT_VERSION = 2")
+    ).replace("SNAPSHOT_VERSION = 2", "SNAPSHOT_VERSION = 3")
     assert _lint_serving(src) == []
 
 

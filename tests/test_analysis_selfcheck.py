@@ -118,6 +118,12 @@ def test_clean_pass_is_not_vacuous():
         "arena/obs/__init__.py": (
             "Observability", [("start_ops", "stop_ops")], set(),
         ),
+        # PR 18: the replica catch-up resources are lifecycle-contracted
+        # — the reader pairs start with close, the cursor owns a wire
+        # connection it must release.
+        "arena/net/replica.py": (
+            "ReplicaReader", [("start", "close")], set(),
+        ),
     }
     for rel, (cls_name, pairs, terminal) in protocols.items():
         path = REPO / rel
@@ -128,6 +134,13 @@ def test_clean_pass_is_not_vacuous():
         assert cls.protocol_terminal >= terminal, (
             f"{rel}: {cls_name} terminal methods drifted"
         )
+    replica_path = REPO / "arena/net/replica.py"
+    replica_ctx = jaxlint.ModuleContext(
+        str(replica_path), replica_path.read_text()
+    )
+    cursor = replica_ctx.symbols.classes["SegmentCursor"]
+    assert cursor.has_protocols(), "SegmentCursor lost its close protocol"
+    assert "close" in cursor.protocol_methods()
     # ...and (v5) the effect pass demonstrably sees the real
     # `# deterministic` / `# pure-render` contracts on the apply and
     # render paths — the annotations ROADMAP items 1 and 2 lean on.
@@ -147,7 +160,13 @@ def test_clean_pass_is_not_vacuous():
         },
         "arena/serving.py": {
             "write_snapshot": "deterministic",
+            "read_snapshot_chain": "deterministic",
             "ArenaServer._player_row": "pure_render",
+        },
+        # PR 18: the replica replay path is `# deterministic` — the
+        # static face of bit-exact log replay.
+        "arena/net/replica.py": {
+            "ReplicaReader._apply_records": "deterministic",
         },
     }
     for rel, expected in contracts.items():
@@ -171,7 +190,8 @@ def test_clean_pass_is_not_vacuous():
     # writers — the shapes the sidecar registry pins.
     schemas = {
         "arena/serving.py": {
-            "write_snapshot": ("arena-snapshot", 1),
+            "write_snapshot": ("arena-snapshot", 2),
+            "_validate_chain_link": ("incremental-manifest", 1),
             "ArenaServer._player_row": ("wire-player-row", 1),
         },
         "arena/net/protocol.py": {
@@ -180,6 +200,14 @@ def test_clean_pass_is_not_vacuous():
         },
         "arena/net/frontdoor.py": {
             "FrontDoor._apply": ("applied-log-record", 1),
+        },
+        # PR 18: the /log writer and the replica-side cursor read/write
+        # the same recorded shape — sidecar wire-log-segment.
+        "arena/net/server.py": {
+            "_log_payload": ("wire-log-segment", 1),
+        },
+        "arena/net/replica.py": {
+            "SegmentCursor.fetch": ("wire-log-segment", 1),
         },
     }
     for rel, expected in schemas.items():
@@ -229,7 +257,7 @@ def test_project_table_covers_every_default_target_module():
     ]
     table = project.ProjectTable([c.symbols for c in contexts])
     for name in ("arena.ingest", "arena.pipeline", "arena.net.frontdoor",
-                 "arena.obs.metrics", "arena.sharding"):
+                 "arena.net.replica", "arena.obs.metrics", "arena.sharding"):
         assert table.module(name) is not None, f"table lost {name}"
     # The sharding module's mesh is resolvable by name — what item 3's
     # multi-host modules will import.

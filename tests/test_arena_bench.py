@@ -499,6 +499,116 @@ def test_frontend_bench_full_size_over_real_http():
     assert fe["trace_dangling_orphans"] == 0
 
 
+REPLICA_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "replica",
+    "ARENA_BENCH_MATCHES": "20000",
+    "ARENA_BENCH_DELTA": "500",
+    "ARENA_BENCH_PLAYERS": "64",
+    "ARENA_BENCH_BATCH": "2048",
+    "ARENA_BENCH_CATCHUP_BATCHES": "2",
+    "ARENA_BENCH_READ_WINDOW_S": "0.3",
+}
+
+
+def test_replica_bench_smoke_contract():
+    """ARENA_BENCH_MODE=replica through the real entrypoint: one JSON
+    line, rc 0, the arena_replica metric with 2 replicas restoring the
+    incremental chain and tailing GET /log over REAL localhost HTTP —
+    the incremental cut >= 5x smaller than a full cut at the same
+    watermark, replica ratings bit-exact to the writer's at equal
+    watermark, catch-up inside its bound under concurrent wire ingest,
+    zero steady-state compiles across writer and replay threads."""
+    result = run_bench(REPLICA_SMOKE_ENV, timeout=300)
+    assert result["metric"] == "arena_replica"
+    assert result["unit"] == "replica_queries_per_s"
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["value"] > 0
+    assert result["params"]["replicas"] == 2
+    rep = result["replica"]
+    snap = rep["snapshot"]
+    assert snap["bytes_ratio"] >= 5.0
+    assert snap["incremental_bytes"] < snap["full_bytes"]
+    assert snap["chain_depth"] == 1
+    assert snap["reuses_base_runs"] is True
+    assert snap["delta_matches"] == snap["churn_matches"] == 2000
+    # The fleet really read and really caught up over the wire.
+    assert rep["aggregate_queries_per_s"] > 0
+    assert rep["single_server_queries_per_s"] > 0
+    assert rep["scaleout_ratio"] >= 0.75
+    assert len(rep["per_replica_queries"]) == 2
+    assert all(q > 0 for q in rep["per_replica_queries"])
+    cu = rep["catchup"]
+    assert cu["streamed_matches"] == 2 * 2 * 500
+    assert cu["catchup_s"] <= cu["catchup_bound_s"]
+    # Warmup batch + every streamed batch reached BOTH replicas.
+    assert cu["records_shipped"] == 2 * (1 + cu["streamed_batches"])
+    assert cu["segments_fetched"] >= 2
+    assert rep["steady_state_new_compiles"] == 0
+    assert rep["staleness_slo_registered"] is True
+
+
+def test_replica_bench_equivalence_gate_is_hard(tmp_path):
+    """The bit-exactness gate covers the replica fleet: with the
+    tolerance forced below zero even a bit-exact run trips it — the
+    distinct equivalence-failure line (replica-mode unit, no
+    throughput fields), rc 2, and a flight-recorder bundle next to
+    the verdict."""
+    result = run_bench(
+        {
+            **REPLICA_SMOKE_ENV,
+            "ARENA_BENCH_TOL": "-1",
+            "ARENA_DEBUG_DIR": str(tmp_path),
+        },
+        timeout=300,
+        expect_rc=2,
+    )
+    assert result["metric"] == "arena_bench_equivalence_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "replica_queries_per_s"
+    assert result["tolerance"] == -1.0
+    assert "exceeds tolerance" in result["error"]
+    assert "replica" not in result
+    bundle = pathlib.Path(result["debug_bundle"])
+    assert bundle.parent == tmp_path
+    assert (bundle / "metrics.json").exists()
+
+
+def test_replica_bench_snapshot_size_gate_is_hard():
+    """The incremental-size gate is a verdict of its own: an impossible
+    ratio floor turns the (really ~10x smaller) delta cut into a
+    measured arena_bench_replica_gate_failure at rc 2 — never a
+    throughput line."""
+    result = run_bench(
+        {**REPLICA_SMOKE_ENV, "ARENA_BENCH_INC_RATIO_MIN": "1000"},
+        timeout=300,
+        expect_rc=2,
+    )
+    assert result["metric"] == "arena_bench_replica_gate_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "replica_queries_per_s"
+    assert "smaller than a full cut" in result["error"]
+    assert "replica" not in result
+
+
+@pytest.mark.slow
+def test_replica_bench_full_size_over_real_http():
+    """The acceptance run at the acceptance size: 2 replicas against
+    the 100k base with 10k-match stream batches — incremental chain
+    >= 5x smaller, bit-exact catch-up under concurrent ingest, zero
+    steady-state compiles."""
+    result = run_bench({"ARENA_BENCH_MODE": "replica"}, timeout=600)
+    assert result["metric"] == "arena_replica"
+    assert result["params"]["base_matches"] == 100_000
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["value"] > 0
+    rep = result["replica"]
+    assert rep["snapshot"]["bytes_ratio"] >= 5.0
+    assert rep["steady_state_new_compiles"] == 0
+    assert rep["catchup"]["catchup_s"] <= rep["catchup"]["catchup_bound_s"]
+
+
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     """The hard gate: with the tolerance forced to 0 the (real, tiny)
     float32-vs-float64 divergence trips it — one JSON line carrying the

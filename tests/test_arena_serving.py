@@ -223,7 +223,7 @@ def test_restore_rejects_mismatched_manifest_version(tmp_path):
     doc["version"] = 99
     man.write_text(json.dumps(doc))
     assert_reject_leaves_engine_untouched(
-        srv, snap, match=r"expected 1, found 99"
+        srv, snap, match=r"expected 2, found 99"
     )
 
 
@@ -233,11 +233,11 @@ def test_restore_rejects_corrupt_binary_header(tmp_path):
     blob[8:12] = (7).to_bytes(4, "little")  # header version field
     (snap / serving.ARRAYS_NAME).write_bytes(bytes(blob))
     assert_reject_leaves_engine_untouched(
-        srv, snap, match=r"header version: expected 1, found 7"
+        srv, snap, match=r"header version: expected 2, found 7"
     )
     # A payload byte flip past the header is caught by the checksum.
     blob = bytearray((snap / serving.ARRAYS_NAME).read_bytes())
-    blob[8:12] = (1).to_bytes(4, "little")
+    blob[8:12] = int(serving.SNAPSHOT_VERSION).to_bytes(4, "little")
     blob[-1] ^= 0xFF
     (snap / serving.ARRAYS_NAME).write_bytes(bytes(blob))
     assert_reject_leaves_engine_untouched(srv, snap, match=r"checksum mismatch")
@@ -298,7 +298,7 @@ def test_snapshot_binary_format_is_versioned_and_checksummed(tmp_path):
     assert blob[:8] == serving.SNAPSHOT_MAGIC
     assert int.from_bytes(blob[8:12], "little") == serving.SNAPSHOT_VERSION
     doc = json.loads((snap / serving.MANIFEST_NAME).read_text())
-    assert doc["magic"] == "ARENASNP" and doc["version"] == 1
+    assert doc["magic"] == "ARENASNP" and doc["version"] == serving.SNAPSHOT_VERSION
     assert doc["bin_bytes"] == len(blob)
     names = {entry["name"] for entry in doc["arrays"]}
     assert {"keys", "pos", "tail_keys", "winners", "losers", "ratings"} <= names
@@ -554,3 +554,189 @@ def test_restore_server_cold_start(tmp_path):
         np.asarray(cold.engine.ratings), np.asarray(srv.engine.ratings)
     )
     assert cold.query(leaderboard=(0, 3))["watermark"] == 300
+
+
+# --- incremental snapshot chains (PR 18) -----------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_incremental_chain_crash_restart_is_bit_exact(tmp_path, seed):
+    """The crash-restart property over a CHAIN: full base + two
+    increments cut at random boundaries, crash, restore the chain
+    head, replay the remainder — ratings bit-exact vs the
+    uninterrupted stream, grouping complete. Nothing compacts here
+    (batches stay far below the floor), so both increments reuse the
+    base's runs and ship zero keys/pos bytes."""
+    w, l = make_matches(1200, seed=seed)
+    batches = random_split(w, l, seed=60 + seed, max_batches=12)
+    rng = np.random.default_rng(91 + seed)
+    cuts = sorted(
+        rng.choice(np.arange(1, len(batches) + 1), size=3, replace=True)
+    )
+    cut1, cut2, cut3 = int(cuts[0]), int(cuts[1]), int(cuts[2])
+
+    uninterrupted = ArenaEngine(P)
+    for bw, bl in batches:
+        uninterrupted.ingest(bw, bl)
+
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    for bw, bl in batches[:cut1]:
+        srv.engine.ingest(bw, bl)
+    srv.snapshot(tmp_path / "base")
+    for bw, bl in batches[cut1:cut2]:
+        srv.engine.ingest(bw, bl)
+    srv.snapshot(tmp_path / "inc1", base=tmp_path / "base")
+    for bw, bl in batches[cut2:cut3]:
+        srv.engine.ingest(bw, bl)
+    srv.snapshot(tmp_path / "inc2", base=tmp_path / "inc1")
+    del srv  # the "crash": only the chain survives
+
+    doc = json.loads((tmp_path / "inc2" / serving.MANIFEST_NAME).read_text())
+    assert doc["kind"] == "incremental"
+    assert doc["chain_depth"] == 2
+    assert doc["base_snapshot"] == "../inc1"
+    assert doc["reuses_base_runs"] is True
+    keys_entry = next(e for e in doc["arrays"] if e["name"] == "keys")
+    assert keys_entry["length"] == 0  # runs ride the base, not the increment
+
+    restored = ArenaServer(num_players=P)
+    restored.restore(tmp_path / "inc2")
+    for bw, bl in batches[cut3:]:
+        restored.engine.ingest(bw, bl)
+    np.testing.assert_array_equal(
+        np.asarray(restored.engine.ratings), np.asarray(uninterrupted.ratings)
+    )
+    assert restored.engine.matches_ingested == len(w)
+    assert_grouping_exact(restored.engine._store, len(w))
+
+
+def test_incremental_snapshot_after_compaction_ships_runs(tmp_path):
+    """A compaction between base and increment means the base's runs
+    are stale: the increment ships its own keys/pos
+    (`reuses_base_runs` False) and the restored store's run/tail split
+    matches the live one exactly."""
+    w, l = make_matches(900, seed=11)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w[:300], l[:300])
+    srv.snapshot(tmp_path / "base")
+    srv.engine.ingest(w[300:600], l[300:600])
+    srv.engine._store.compact()
+    srv.engine.ingest(w[600:], l[600:])
+    srv.snapshot(tmp_path / "inc", base=tmp_path / "base")
+
+    doc = json.loads((tmp_path / "inc" / serving.MANIFEST_NAME).read_text())
+    assert doc["reuses_base_runs"] is False
+    assert doc["delta_matches"] == 600
+    store = srv.engine._store
+
+    restored = ArenaServer(num_players=P)
+    restored.restore(tmp_path / "inc")
+    rstore = restored.engine._store
+    assert rstore.compactions == store.compactions
+    np.testing.assert_array_equal(rstore._keys, store._keys)
+    np.testing.assert_array_equal(rstore._pos, store._pos)
+    np.testing.assert_array_equal(
+        np.asarray(restored.engine.ratings), np.asarray(srv.engine.ratings)
+    )
+    assert_grouping_exact(rstore, 900)
+
+
+def build_incremental_chain(tmp_path, n=600, seed=21):
+    w, l = make_matches(n, seed=seed)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w[: n // 2], l[: n // 2])
+    srv.snapshot(tmp_path / "base")
+    srv.engine.ingest(w[n // 2:], l[n // 2:])
+    srv.snapshot(tmp_path / "inc", base=tmp_path / "base")
+    return srv, tmp_path / "base", tmp_path / "inc"
+
+
+def test_restore_rejects_truncated_or_corrupt_increment(tmp_path):
+    """A torn or tampered INCREMENT is rejected before any state is
+    touched — truncation, a payload byte flip, and a delta count that
+    disagrees with the shipped arrays each name what broke."""
+    srv, _base, inc = build_incremental_chain(tmp_path)
+    pristine = (inc / serving.ARRAYS_NAME).read_bytes()
+    (inc / serving.ARRAYS_NAME).write_bytes(pristine[: len(pristine) // 2])
+    assert_reject_leaves_engine_untouched(srv, inc, match=r"truncated")
+    blob = bytearray(pristine)
+    blob[-1] ^= 0xFF
+    (inc / serving.ARRAYS_NAME).write_bytes(bytes(blob))
+    assert_reject_leaves_engine_untouched(srv, inc, match=r"checksum mismatch")
+    (inc / serving.ARRAYS_NAME).write_bytes(pristine)
+    man = inc / serving.MANIFEST_NAME
+    pristine_man = man.read_text()
+    doc = json.loads(pristine_man)
+    doc["delta_matches"] += 5
+    doc["num_matches"] += 5
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(
+        srv, inc, match=r"incremental match-log delta"
+    )
+    # ...and an increment that smuggles full rows is rejected too.
+    doc = json.loads(pristine_man)
+    doc["kind"] = "full"
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv, inc, match=r"must not name a base")
+
+
+def test_restore_rejects_swapped_or_tampered_base_chain(tmp_path):
+    """Chain integrity is pinned by CONTENT, not by path: swapping a
+    SELF-CONSISTENT but different base under an increment (same
+    players, same match count, different matches — every per-directory
+    check passes) is caught by the base-checksum link, tampered
+    chain_depth by the depth link, and a self-referencing base by the
+    cycle guard. The mutant that skips `_validate_chain_link`'s
+    checksum check dies here."""
+    srv, base, inc = build_incremental_chain(tmp_path)
+    # An impostor base: identical shape and counts, different stream.
+    other = ArenaServer(num_players=P, max_staleness_matches=0)
+    ow, ol = make_matches(300, seed=777)
+    other.engine.ingest(ow, ol)
+    other.snapshot(tmp_path / "impostor")
+    import shutil
+
+    shutil.rmtree(base)
+    shutil.copytree(tmp_path / "impostor", base)
+    assert_reject_leaves_engine_untouched(
+        srv, inc, match=r"snapshot chain broken at .*cut against base arrays"
+    )
+    other.close()
+
+    # Tampered chain_depth on the head (the manifest is not inside the
+    # arrays checksum — the LINK check still catches it).
+    srv2, _base2, inc2 = build_incremental_chain(tmp_path / "t2")
+    man = inc2 / serving.MANIFEST_NAME
+    doc = json.loads(man.read_text())
+    doc["chain_depth"] = 5
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv2, inc2, match=r"chain_depth 5")
+
+    # A cycle: the increment naming itself as base never loops forever.
+    doc["chain_depth"] = 1
+    doc["base_snapshot"] = "../inc"
+    man.write_text(json.dumps(doc))
+    assert_reject_leaves_engine_untouched(srv2, inc2, match=r"chain cycles")
+
+
+def test_incremental_snapshot_write_side_rejects_foreign_base(tmp_path):
+    """The WRITE side refuses to cut an increment against a base from
+    a different arena (player count) or a base AHEAD of the live
+    stream — the reject happens before any bytes hit disk."""
+    w, l = make_matches(300, seed=31)
+    srv = ArenaServer(num_players=P, max_staleness_matches=0)
+    srv.engine.ingest(w, l)
+    srv.snapshot(tmp_path / "base")
+    behind = ArenaServer(num_players=P, max_staleness_matches=0)
+    behind.engine.ingest(w[:100], l[:100])
+    with pytest.raises(SnapshotError, match=r"AHEAD of the live state"):
+        behind.snapshot(tmp_path / "bad", base=tmp_path / "base")
+    assert not (tmp_path / "bad").exists()
+    foreign = ArenaServer(num_players=P + 1, max_staleness_matches=0)
+    fw, fl = make_matches(300, num_players=P + 1, seed=32)
+    foreign.engine.ingest(fw, fl)
+    with pytest.raises(SnapshotError, match=r"base mismatch on 'num_players'"):
+        foreign.snapshot(tmp_path / "bad", base=tmp_path / "base")
+    assert not (tmp_path / "bad").exists()
+    behind.close()
+    foreign.close()

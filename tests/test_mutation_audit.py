@@ -81,7 +81,9 @@ def test_mutations_cover_every_policed_surface():
     one-view contract, the event-loop read front end's default), and
     since PR 17 the jaxlint v6 schema analyzer (the shape-fact
     extractor, the version-bump comparison direction, the replication
-    closure's fixpoint)."""
+    closure's fixpoint), and since PR 18 the replication layer (the
+    replica's strict-sequence apply, the incremental snapshot chain's
+    base-identity link, the staleness objective's burn-rate pull)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -106,6 +108,7 @@ def test_mutations_cover_every_policed_surface():
         "arena/net/protocol.py",
         "arena/net/server.py",
         "arena/net/fastpath.py",
+        "arena/net/replica.py",
     }
 
 
@@ -150,6 +153,7 @@ def _fake_sources_only(dest):
         "arena/net/protocol.py",
         "arena/net/server.py",
         "arena/net/fastpath.py",
+        "arena/net/replica.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)

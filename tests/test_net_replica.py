@@ -1,0 +1,454 @@
+"""Replica catch-up and time travel (arena/net/replica.py, GET /log).
+
+ROADMAP item 2's read-fleet contracts, pinned over a REAL wire:
+
+- `GET /log` pages the writer's applied log in strict sequence order,
+  seats a restored replica's cursor by watermark, and answers 503/409
+  (no log / non-boundary watermark) instead of shipping garbage;
+- a `ReplicaReader` restored from an incremental-chain snapshot tails
+  the writer and is BIT-EXACT at equal watermark — including across
+  forced overload sheds, whose coalesced summary records replay like
+  any other record;
+- replay is strict: an out-of-sequence record, an unknown kind, or a
+  record whose post-apply watermark disagrees with the writer's is a
+  raised `ReplicaError`, never a silently forked replica (the audit's
+  replica-applies-arrival-order mutant dies here);
+- `?as_of=` time-travel reads equal a synchronous replay of the same
+  log prefix (the audit's staleness-slo-never-evaluated mutant dies on
+  the SLO assertions, and the profiler maps the tail/replay threads to
+  their roles).
+"""
+
+import numpy as np
+import pytest
+
+from arena.engine import ArenaEngine
+from arena.net import ArenaHTTPServer, FrontDoor, WireClient
+from arena.net.replica import (
+    ReplicaError,
+    ReplicaReader,
+    SegmentCursor,
+    TimeTravelIndex,
+)
+from arena.obs import Observability
+from arena.obs.profile import thread_role
+from arena.serving import ArenaServer
+
+PLAYERS = 32
+
+
+def make_batch(rng, n=40):
+    a = rng.integers(0, PLAYERS, n).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, PLAYERS - 1, n)) % PLAYERS).astype(np.int32)
+    return a, b
+
+
+class WriterStack:
+    """One writer: ArenaServer + recording FrontDoor + wire tier."""
+
+    def __init__(self):
+        self.obs = Observability()
+        self.srv = ArenaServer(
+            num_players=PLAYERS, max_staleness_matches=0, obs=self.obs
+        )
+        self.frontdoor = FrontDoor(
+            self.srv.engine, capacity=64, record_applied=True
+        )
+        self.wire = ArenaHTTPServer(self.srv, frontdoor=self.frontdoor).start()
+        self.client = WireClient(self.wire.host, self.wire.port)
+        self.rng = np.random.default_rng(17)
+
+    def feed(self, batches, n=40):
+        for _ in range(batches):
+            w, l = make_batch(self.rng, n)
+            self.frontdoor.submit(w, l, producer="writer")
+        self.frontdoor.flush()
+        return self.srv.engine.matches_applied
+
+    def close(self):
+        self.client.close()
+        self.wire.close()
+        self.frontdoor.close()
+        self.srv.close()
+
+
+@pytest.fixture()
+def writer():
+    stack = WriterStack()
+    yield stack
+    stack.close()
+
+
+def make_replica(snapshot, host, port, **kwargs):
+    obs = Observability()
+    rsrv = ArenaServer(num_players=PLAYERS, max_staleness_matches=0, obs=obs)
+    reader = ReplicaReader(rsrv, host, port, snapshot=snapshot, **kwargs)
+    return rsrv, reader
+
+
+def replay_sync(frontdoor, up_to_watermark):
+    """The oracle: replay the writer's applied log SYNCHRONOUSLY to a
+    watermark on a fresh engine."""
+    eng = ArenaEngine(PLAYERS)
+    for (kind, w, l), mark in zip(
+        frontdoor.applied_log, frontdoor.applied_watermarks
+    ):
+        if mark > up_to_watermark:
+            break
+        assert kind in ("summary", "batch")
+        eng.ingest(w, l)
+    ratings = np.asarray(eng.ratings).copy()
+    eng.shutdown()
+    return ratings
+
+
+# --- GET /log ---------------------------------------------------------------
+
+
+def test_log_endpoint_pages_in_sequence_order(writer):
+    writer.feed(6)
+    status, doc = writer.client.get("/log?after_seq=-1&limit=4")
+    assert status == 200
+    assert [r["seq"] for r in doc["records"]] == [0, 1, 2, 3]
+    assert doc["next_seq"] == 4 and doc["log_len"] == 6
+    assert doc["base_watermark"] == 0
+    assert doc["watermark"] == writer.srv.engine.matches_applied
+    # Record watermarks are cumulative post-apply marks.
+    assert [r["record_watermark"] for r in doc["records"]] == [
+        40, 80, 120, 160
+    ]
+    status, doc = writer.client.get("/log?after_seq=3")
+    assert status == 200
+    assert [r["seq"] for r in doc["records"]] == [4, 5]
+    assert doc["next_seq"] == 6
+    # Watermark alignment: a restored replica seats its cursor at a
+    # record boundary without re-shipping history.
+    status, doc = writer.client.get("/log?after_watermark=120")
+    assert status == 200
+    assert doc["records"][0]["seq"] == 3
+    status, doc = writer.client.get("/log?after_watermark=0")
+    assert status == 200
+    assert doc["records"][0]["seq"] == 0
+    # A watermark BETWEEN record boundaries is a 409 conflict — the
+    # replica must re-seat from a boundary snapshot, not guess.
+    status, doc = writer.client.get("/log?after_watermark=130")
+    assert status == 409
+    assert "boundary" in doc["error"]
+    # Malformed cursors are 400s.
+    status, _doc = writer.client.get("/log?after_seq=-2")
+    assert status == 400
+    status, _doc = writer.client.get("/log?after_seq=nope")
+    assert status == 400
+
+
+def test_log_endpoint_503_without_a_recording_frontdoor(writer):
+    # A read-only replica (no front door) ships no log...
+    rsrv = ArenaServer(num_players=PLAYERS, max_staleness_matches=0)
+    rwire = ArenaHTTPServer(rsrv, frontdoor=None).start()
+    rclient = WireClient(rwire.host, rwire.port)
+    try:
+        status, doc = rclient.get("/log?after_seq=-1")
+        assert status == 503
+        assert "read-only" in doc["error"]
+        # ...and neither does a front door that is not recording.
+        eng = ArenaEngine(PLAYERS)
+        fd = FrontDoor(eng, capacity=8, record_applied=False)
+        try:
+            with pytest.raises(Exception, match="record_applied"):
+                fd.log_segment()
+        finally:
+            fd.close()
+            eng.shutdown()
+    finally:
+        rclient.close()
+        rwire.close()
+        rsrv.close()
+
+
+# --- replica catch-up -------------------------------------------------------
+
+
+def test_replica_catches_up_bit_exact_across_overload_sheds(writer, tmp_path):
+    """The tentpole property over the wire: snapshot -> restore ->
+    tail -> strict replay == writer, bit for bit, at equal watermark —
+    with the log containing coalesced SUMMARY records from forced
+    overload sheds on both sides of the snapshot cut."""
+    fd = writer.frontdoor
+    # Shed BEFORE the snapshot: pause the apply path, overflow the
+    # 64-slot buffer, resume — the oldest batches coalesce into one
+    # summary record that lands in the log.
+    fd.pause()
+    for _ in range(70):
+        w, l = make_batch(writer.rng)
+        fd.submit(w, l, producer="burst")
+    fd.resume()
+    fd.flush()
+    assert fd.shed_batches > 0
+    writer.feed(5)
+    snap = tmp_path / "base"
+    writer.srv.snapshot(snap)
+    snap_watermark = writer.srv.engine.matches_applied
+
+    wm_mid = writer.feed(5)
+    rsrv, reader = make_replica(snap, writer.wire.host, writer.wire.port)
+    assert reader.watermark() == snap_watermark  # restored, not replayed
+    reader.start()
+    try:
+        reader.wait_for_watermark(wm_mid)
+        # Shed AFTER the replica is already tailing.
+        fd.pause()
+        for _ in range(70):
+            w, l = make_batch(writer.rng)
+            fd.submit(w, l, producer="burst2")
+        fd.resume()
+        fd.flush()
+        wm_end = writer.feed(3)
+        reader.wait_for_watermark(wm_end)
+
+        w_ratings, w_mark = writer.srv.engine.ratings_snapshot()
+        r_ratings, r_mark = rsrv.engine.ratings_snapshot()
+        assert w_mark == r_mark == wm_end
+        np.testing.assert_array_equal(
+            np.asarray(w_ratings), np.asarray(r_ratings)
+        )
+        # The replayed records include summary kinds, and the replica's
+        # retained log replays to the same ratings synchronously.
+        kinds = {kind for _seq, kind, _w, _l, _wm in reader.records}
+        assert "summary" in kinds and "batch" in kinds
+        np.testing.assert_array_equal(
+            np.asarray(w_ratings), replay_sync(fd, wm_end)
+        )
+        # The replica SERVES what it replayed, read-only.
+        rwire = ArenaHTTPServer(rsrv, frontdoor=None).start()
+        rclient = WireClient(rwire.host, rwire.port)
+        try:
+            _s, board = rclient.get("/leaderboard?offset=0&limit=10")
+            _s, wboard = writer.client.get("/leaderboard?offset=0&limit=10")
+            assert board["leaderboard"] == wboard["leaderboard"]
+            status, _doc = rclient.post(
+                "/submit", {"winners": [1], "losers": [2], "producer": "x"}
+            )
+            assert status == 503  # replicas take no writes
+        finally:
+            rclient.close()
+            rwire.close()
+    finally:
+        reader.close()
+        rsrv.close()
+
+
+def test_replica_refuses_out_of_sequence_and_diverged_records(writer):
+    """Strict replay: arrival order is NOT apply order. A record that
+    skips ahead, an unknown kind, and a record whose post-apply
+    watermark disagrees with the writer's are each a distinct
+    `ReplicaError` raised BEFORE the bad record can fork the replica.
+    Named kill for the replica-applies-arrival-order-not-sequence-order
+    mutant."""
+    rsrv = ArenaServer(num_players=PLAYERS, max_staleness_matches=0)
+    reader = ReplicaReader(rsrv, writer.wire.host, writer.wire.port)
+    try:
+        rec = {
+            "seq": 0, "kind": "batch", "winners": [0, 1], "losers": [2, 3],
+            "record_watermark": 2,
+        }
+        reader._apply_records([rec])
+        assert reader.watermark() == 2 and reader.applied_seq() == 0
+        # seq 2 after seq 0: a gap — refused, nothing applied.
+        bad = dict(rec, seq=2, record_watermark=4)
+        with pytest.raises(ReplicaError, match="out of sequence"):
+            reader._apply_records([bad])
+        assert reader.watermark() == 2
+        with pytest.raises(ReplicaError, match="unknown log record kind"):
+            reader._apply_records([dict(rec, seq=1, kind="mystery")])
+        # A watermark cross-check failure is DIVERGENCE, not progress.
+        with pytest.raises(ReplicaError, match="watermark diverged"):
+            reader._apply_records([dict(rec, seq=1, record_watermark=99)])
+    finally:
+        reader.close()
+        rsrv.close()
+
+
+def test_segment_cursor_rejects_a_gapped_page(writer, monkeypatch):
+    """The transport-level guard: a /log page whose records do not
+    continue the cursor's sequence is an error at the CURSOR, before
+    any record reaches an engine."""
+    cursor = SegmentCursor(writer.wire.host, writer.wire.port)
+    try:
+        writer.feed(2)
+        page = cursor.fetch()
+        assert [r["seq"] for r in page] == [0, 1]
+        gapped = {
+            "records": [
+                {"seq": 3, "kind": "batch", "winners": [0], "losers": [1],
+                 "record_watermark": 120}
+            ],
+            "next_seq": 4, "log_len": 4, "base_watermark": 0, "watermark": 120,
+        }
+        monkeypatch.setattr(cursor._client, "get", lambda path: (200, gapped))
+        with pytest.raises(ReplicaError, match="breaks the sequence"):
+            cursor.fetch()
+        # A non-200 answer is a named error too, not a None-deref.
+        monkeypatch.setattr(
+            cursor._client, "get", lambda path: (503, {"error": "nope"})
+        )
+        with pytest.raises(ReplicaError, match="answered 503"):
+            cursor.fetch()
+    finally:
+        cursor.close()
+
+
+def test_cursor_aligned_at_the_head_does_not_reship_history(writer):
+    """A replica restored exactly at the writer's head gets an EMPTY
+    alignment page — the cursor must adopt the writer's next_seq from
+    it, not fall back to seq 0 on the next poll and re-ship history
+    into the divergence check (found live by the replica bench)."""
+    wm = writer.feed(3)
+    cursor = SegmentCursor(
+        writer.wire.host, writer.wire.port, start_watermark=wm
+    )
+    try:
+        assert cursor.fetch() == []
+        assert cursor.next_seq == 3
+        writer.feed(1)
+        page = cursor.fetch()
+        assert [r["seq"] for r in page] == [3]
+        assert page[0]["record_watermark"] == wm + 40
+    finally:
+        cursor.close()
+
+
+def test_replica_staleness_slo_and_profiler_roles(writer, tmp_path):
+    """start() registers the replica-staleness objective on the
+    replica's own burn-rate engine and every tail poll EVALUATES it —
+    the engine-side `evaluations` counter is the evidence (named kill
+    for the staleness-slo-never-evaluated mutant). The tail/replay
+    threads carry the profiler's replica roles."""
+    writer.feed(4)
+    snap = tmp_path / "snap"
+    writer.srv.snapshot(snap)
+    wm = writer.feed(2)
+    rsrv, reader = make_replica(snap, writer.wire.host, writer.wire.port)
+    robs = rsrv.obs
+    assert "replica-staleness" not in [s.name for s in robs.slo.slos]
+    reader.start()
+    try:
+        reader.wait_for_watermark(wm)
+        assert "replica-staleness" in [s.name for s in robs.slo.slos]
+        assert robs.slo.evaluations > 0, (
+            "the staleness objective was registered but never evaluated"
+        )
+        # The staleness histogram took real observations.
+        hist = robs.histogram("arena_replica_staleness_matches", base=1.0)
+        assert hist.snapshot()["count"] > 0
+        # /debug/slo on the REPLICA's wire surfaces the objective.
+        rwire = ArenaHTTPServer(rsrv, frontdoor=None).start()
+        rclient = WireClient(rwire.host, rwire.port)
+        try:
+            _s, doc = rclient.get("/debug/slo")
+            assert "replica-staleness" in doc["objectives"]
+        finally:
+            rclient.close()
+            rwire.close()
+    finally:
+        reader.close()
+        rsrv.close()
+    assert thread_role("arena-replica-tail") == "replica-tail"
+    assert thread_role("arena-replica-replay-1") == "replica-replay"
+
+
+# --- time travel ------------------------------------------------------------
+
+
+def test_time_travel_reads_match_sync_replay(writer, tmp_path):
+    """`?as_of=W` == a synchronous replay of the same log prefix: for
+    every record boundary covered by a retained snapshot, the
+    time-travel ratings equal the oracle's, the payload carries
+    as_of/as_of_watermark, and the envelope watermark is the
+    HISTORICAL one. Non-boundary as_of answers at the greatest
+    boundary <= as_of; below-oldest-snapshot is a 404; the fastpath
+    byte cache is bypassed in both directions."""
+    writer.feed(4)
+    snap1 = tmp_path / "s1"
+    writer.srv.snapshot(snap1)
+    wm1 = writer.srv.engine.matches_applied
+    writer.feed(4)
+    snap2 = tmp_path / "s2"
+    writer.srv.snapshot(snap2, base=snap1)
+    wm_end = writer.feed(3)
+
+    index = TimeTravelIndex(
+        writer.srv, writer.frontdoor, snapshots=[snap1, snap2]
+    )
+    writer.wire.time_travel = index
+    hits = writer.obs.counter("arena_wire_cache_hits_total")
+    misses = writer.obs.counter("arena_wire_cache_misses_total")
+    cache_before = (hits.value, misses.value)
+
+    for as_of in (wm1, wm1 + 40, wm_end):
+        status, doc = writer.client.get(
+            f"/leaderboard?offset=0&limit={PLAYERS}&as_of={as_of}"
+        )
+        assert status == 200
+        assert doc["as_of"] == as_of
+        assert doc["as_of_watermark"] <= as_of
+        assert doc["watermark"] == doc["as_of_watermark"]
+        oracle = replay_sync(writer.frontdoor, as_of)
+        assert len(doc["leaderboard"]) == PLAYERS
+        for row in doc["leaderboard"]:
+            assert row["rating"] == float(oracle[row["player"]])
+        # /player as-of agrees with the oracle row for that player.
+        status, pdoc = writer.client.get(f"/player/3?as_of={as_of}")
+        assert status == 200
+        assert pdoc["players"][0]["rating"] == float(oracle[3])
+    # A non-boundary as_of answers at the previous record boundary.
+    status, doc = writer.client.get(
+        f"/leaderboard?offset=0&limit=5&as_of={wm1 + 13}"
+    )
+    assert status == 200
+    assert doc["as_of_watermark"] == wm1
+    # Below the oldest retained snapshot: 404, with the envelope intact.
+    status, doc = writer.client.get("/leaderboard?offset=0&limit=5&as_of=1")
+    assert status == 404
+    assert "watermark" in doc and "trace_id" in doc
+    # as_of never fills or reads the byte cache — historical answers
+    # must not evict (or masquerade as) live fastpath entries.
+    assert (hits.value, misses.value) == cache_before
+    # Without a configured index, as_of is a 503 (contract, not a 500).
+    writer.wire.time_travel = None
+    status, doc = writer.client.get("/leaderboard?offset=0&limit=5&as_of=40")
+    assert status == 503
+
+
+def test_time_travel_on_a_replica_uses_its_retained_log(writer, tmp_path):
+    """The same index works on a REPLICA with the reader's retained
+    records as the log source — historical reads answered entirely
+    from shipped state."""
+    writer.feed(3)
+    snap = tmp_path / "snap"
+    writer.srv.snapshot(snap)
+    wm_snap = writer.srv.engine.matches_applied
+    wm_end = writer.feed(3)
+
+    rsrv, reader = make_replica(snap, writer.wire.host, writer.wire.port)
+    reader.start()
+    try:
+        reader.wait_for_watermark(wm_end)
+        index = TimeTravelIndex(rsrv, reader, snapshots=[snap])
+        mid = wm_snap + 40  # one record past the snapshot boundary
+        payload = index.leaderboard(0, PLAYERS, mid)
+        assert payload["as_of_watermark"] == mid
+        oracle = replay_sync(writer.frontdoor, mid)
+        for row in payload["leaderboard"]:
+            assert row["rating"] == float(oracle[row["player"]])
+        # The replica's log_segment mirrors the front door's shape.
+        records, next_seq, log_len, base = reader.log_segment(
+            after_watermark=wm_snap
+        )
+        assert base == wm_snap
+        assert log_len == len(reader.records)
+        assert records[0][4] == wm_snap + 40
+        with pytest.raises(ValueError, match="boundary"):
+            reader.log_segment(after_watermark=wm_snap + 1)
+    finally:
+        reader.close()
+        rsrv.close()

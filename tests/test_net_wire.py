@@ -384,7 +384,14 @@ GOLDEN_RESPONSE_KEYS = {
                                "alerts_fired_total", "window_s"},
     "/debug/profile": _ENVELOPE | {"hz", "samples", "running", "error",
                                    "roles", "top"},
+    # PR 18: the replication log page a replica's SegmentCursor reads.
+    "/log?after_seq=-1&limit=2": _ENVELOPE | {"records", "next_seq",
+                                              "log_len", "base_watermark"},
 }
+
+# Time-travel responses are the query shape plus the as_of markers
+# (asserted separately: ?as_of needs a TimeTravelIndex wired in).
+_AS_OF_KEYS = {"as_of", "as_of_watermark"}
 
 
 def test_every_endpoint_matches_its_golden_key_set(wire):
@@ -418,6 +425,40 @@ def test_every_endpoint_matches_its_golden_key_set(wire):
     for row in board["leaderboard"]:
         assert set(row) == {"player", "rating", "lo", "hi", "wins",
                             "losses", "rank"}
+    # /log record rows are the wire-log-segment record shape.
+    _status, log_page = client.get("/log?after_seq=-1&limit=1")
+    for rec in log_page["records"]:
+        assert set(rec) == {"seq", "kind", "winners", "losers",
+                            "record_watermark"}
+
+
+def test_as_of_responses_match_the_golden_query_shape(wire, tmp_path):
+    """`?as_of=` answers are the EXACT query response shape plus the
+    two time-travel markers — same sidecar (wire-query-response), same
+    row schema, historical watermark in the envelope."""
+    from arena.net.replica import TimeTravelIndex
+
+    server, client = wire
+    server.frontdoor.flush()
+    snap = tmp_path / "golden-asof"
+    server.server.snapshot(snap)
+    as_of = int(server.server.engine.matches_applied)
+    server.time_travel = TimeTravelIndex(
+        server.server, server.frontdoor, snapshots=[snap]
+    )
+    try:
+        _status, doc = client.get(f"/leaderboard?offset=0&limit=3&as_of={as_of}")
+        assert set(doc) == GOLDEN_RESPONSE_KEYS[
+            "/leaderboard?offset=0&limit=5"
+        ] | _AS_OF_KEYS
+        for row in doc["leaderboard"]:
+            assert set(row) == {"player", "rating", "lo", "hi", "wins",
+                                "losses", "rank"}
+        _status, doc = client.get(f"/player/3?as_of={as_of}")
+        assert set(doc) == GOLDEN_RESPONSE_KEYS["/player/3"] | _AS_OF_KEYS
+        assert doc["watermark"] == doc["as_of_watermark"]
+    finally:
+        server.time_travel = None
 
 
 def test_golden_key_sets_stay_inside_the_checked_in_sidecars():
@@ -441,9 +482,12 @@ def test_golden_key_sets_stay_inside_the_checked_in_sidecars():
         "/debug/window": "wire-debug-window",
         "/debug/slo": "wire-debug-slo",
         "/debug/profile": "wire-debug-profile",
+        "/log?after_seq=-1&limit=2": "wire-log-segment",
     }
     envelope = declared("wire-envelope")
     assert envelope == _ENVELOPE
     for path, sidecar in by_sidecar.items():
         undeclared = GOLDEN_RESPONSE_KEYS[path] - declared(sidecar) - envelope
         assert not undeclared, f"{path}: {sorted(undeclared)} not in {sidecar}"
+    # The as_of markers ride the same wire-query-response sidecar.
+    assert _AS_OF_KEYS <= declared("wire-query-response")

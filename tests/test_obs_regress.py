@@ -193,10 +193,11 @@ def test_repo_baseline_file_is_valid():
     doc = regress.load_baseline(REPO / "BENCH_BASELINE.json")
     assert set(doc["metrics"]) == {
         "arena_elo_update_speedup", "arena_ingest", "arena_pipeline",
-        "arena_serve", "arena_soak", "arena_frontend",
+        "arena_serve", "arena_soak", "arena_frontend", "arena_replica",
     }
     assert doc["metrics"]["arena_soak"]["direction"] == "lower"
     assert doc["metrics"]["arena_frontend"]["direction"] == "higher"
+    assert doc["metrics"]["arena_replica"]["direction"] == "higher"
 
 
 @pytest.mark.slow
