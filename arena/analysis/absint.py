@@ -213,7 +213,7 @@ TAINT_SOURCE_TAILS = frozenset({"parse_qs", "parse_qsl"})
 # pinning `parse_submit_body` as a sanitizer goes red.
 TAINT_SANITIZER_TAILS = frozenset({
     "parse_submit_body", "parse_path", "_query_int", "_validate_matches",
-    "pack_batch", "pack_epoch",
+    "_validate_tenant", "pack_batch", "pack_epoch",
 })
 
 # Sinks: engine/front-door mutation calls. Generic-looking tails

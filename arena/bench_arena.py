@@ -149,8 +149,31 @@ rather than demanding parallel speedup; the measured ratio is reported
 for multi-core boxes. The headline ``value`` is the fleet's aggregate
 wire queries/s.
 
+An eighth mode, ``ARENA_BENCH_MODE=tenant``, measures MULTI-TENANT
+FUSION (`arena/tenancy.py`): thousands of independent leaderboards
+riding ONE jitted kernel via tenant-composite segment ids. The engine
+starts just past the tenant-bucket midpoint, warms the fused update,
+then GROWS to the full tenant count round by round under a
+thread-aware `RecompileSentinel` — the within-bucket growth HARD gate
+requires ZERO new compiles while tenants are added (the tenant axis is
+pow2-bucketed exactly like the row axis). Timed rounds then drive
+every tenant's matches through single fused updates; the same per-
+tenant streams replay through N DEDICATED single-tenant engines (one
+`ArenaEngine` per tenant, warmup excluded from timing) — the speedup
+HARD gate requires the batched path at least
+``ARENA_BENCH_TENANT_MIN_SPEEDUP`` (5x) faster than the dedicated
+loop, and the bit-exactness HARD gate requires EVERY tenant's ratings
+row identical (`np.array_equal`, not a tolerance) to its dedicated
+engine — including a deliberately empty tenant (zero matches must
+leave base ratings untouched bit-for-bit). The ops-plane HARD gate
+requires the per-tenant ingest counters
+(``arena_tenant_matches_total{tenant=...}``) on ONE live registry to
+reconcile exactly with the matches each tenant submitted — one ops
+plane, tenant-labeled, not N. The headline ``value`` is the
+batched-vs-dedicated speedup.
+
 Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest | pipeline |
-serve | soak | frontend | replica),
+serve | soak | frontend | replica | tenant),
 ARENA_BENCH_MATCHES (100000), ARENA_BENCH_PLAYERS (1000),
 ARENA_BENCH_BATCH (8192), ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED
 (0), ARENA_BENCH_BT_ITERS (25), ARENA_BENCH_TOL (0.5 rating points —
@@ -175,7 +198,11 @@ catch-up lag bound), ARENA_BENCH_READ_WINDOW_S (0.5, each read-
 throughput measurement window), ARENA_BENCH_REPLICA_SCALEOUT_MIN
 (0.75, the aggregate-vs-single-server floor),
 ARENA_BENCH_INC_RATIO_MIN (5.0, the full-vs-incremental snapshot
-bytes floor),
+bytes floor), ARENA_BENCH_TENANTS (256, tenant mode),
+ARENA_BENCH_TENANT_PLAYERS (1000, players per tenant),
+ARENA_BENCH_TENANT_ROUND (256, matches per tenant per round),
+ARENA_BENCH_TENANT_ROUNDS (4, timed rounds),
+ARENA_BENCH_TENANT_MIN_SPEEDUP (5.0, the batched-vs-dedicated floor),
 ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
 sharded path when the backend is not yet initialized),
 ARENA_BENCH_HISTORY (unset — append every emitted JSON line to this
@@ -215,6 +242,7 @@ import numpy as np  # noqa: E402
 
 import bench  # noqa: E402  (exc_detail — the repo-wide error formatting)
 from arena import baseline, engine, ingest, ratings, serving, sharding  # noqa: E402
+from arena import tenancy  # noqa: E402
 from arena import net  # noqa: E402
 from arena import obs as obs_pkg  # noqa: E402
 from arena.analysis import sanitize  # noqa: E402
@@ -342,6 +370,14 @@ class ReplicaGateError(AssertionError):
     throughput fell structurally below one server's, catch-up lag blew
     its bound under concurrent wire ingest, or a steady-state record
     replay recompiled."""
+
+
+class TenantGateError(AssertionError):
+    """A tenant-bench hard gate failed: the fused multi-tenant update
+    fell below the speedup floor over dedicated per-tenant engines, a
+    tenant's ratings diverged bitwise from its dedicated reference,
+    within-bucket tenant growth recompiled, or the tenant-labeled ops
+    plane failed to reconcile with the per-tenant match counts."""
 
 
 def _env_int(name, default):
@@ -2223,6 +2259,222 @@ def run_replica_benchmark():
     return result
 
 
+def run_tenant_benchmark():
+    """Multi-tenant fusion: N leaderboards through ONE jitted kernel.
+
+    Phases: (1) within-bucket tenant GROWTH under a RecompileSentinel
+    (HARD gate: zero new compiles while tenants are added inside one
+    pow2 tenant bucket); (2) timed batched rounds — every tenant's
+    matches in one fused update per round; (3) the dedicated loop —
+    one `ArenaEngine` per tenant replays the same streams (compile
+    warmup excluded from timing); (4) HARD gates: batched >= MIN_SPEEDUP
+    x dedicated, every tenant's ratings row BIT-EXACT vs its dedicated
+    engine (a zero-match tenant included), and the tenant-labeled
+    counters on the single live registry reconciling exactly with the
+    per-tenant match counts."""
+    num_tenants = _env_int("ARENA_BENCH_TENANTS", 256)
+    players = _env_int("ARENA_BENCH_TENANT_PLAYERS", 1_000)
+    round_matches = _env_int("ARENA_BENCH_TENANT_ROUND", 256)
+    rounds = _env_int("ARENA_BENCH_TENANT_ROUNDS", 4)
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+    min_speedup = float(
+        os.environ.get("ARENA_BENCH_TENANT_MIN_SPEEDUP", 5.0)
+    )
+    if num_tenants < 2:
+        raise ValueError(f"tenant mode needs >= 2 tenants, got {num_tenants}")
+
+    bucket = tenancy.tenant_bucket(num_tenants)
+    # Start just past the bucket midpoint: every growth step below
+    # stays INSIDE the final bucket, so the sentinel polices pure
+    # bookkeeping (the gate's whole point).
+    grow_from = max(2, min(num_tenants, bucket // 2 + 1))
+    grow_steps = sorted(
+        {
+            grow_from + ((num_tenants - grow_from) * i) // 4
+            for i in (1, 2, 3, 4)
+        }
+        | {num_tenants}
+    )
+    # Bit-exactness contract (arena/tenancy.py): both paths must pack
+    # each round into the SAME row bucket. Every active tenant gets
+    # exactly `round_matches` per round, and the dedicated engines pin
+    # `min_bucket=row_bucket`, so both sides pad identically.
+    row_bucket = engine.bucket_size(round_matches)
+    # One tenant deliberately NEVER receives a match: its batched row
+    # must stay base ratings bit-for-bit (the +-0.0 delta property).
+    zero_tenant = num_tenants - 1
+
+    obs = obs_pkg.Observability()
+    _register_active_obs(obs)
+    eng = tenancy.MultiTenantEngine(
+        players, num_tenants=grow_from, min_bucket=row_bucket, obs=obs
+    )
+
+    # Per-tenant synthetic streams, sliced one round at a time; every
+    # consumed slice is recorded for the dedicated replay.
+    max_rounds = 2 + len(grow_steps) + rounds
+    streams = {}
+    for t in range(num_tenants):
+        if t == zero_tenant:
+            continue
+        streams[t] = make_matches(
+            max_rounds * round_matches, players, seed + 7919 * t
+        )
+    cursors = {t: 0 for t in streams}
+    history = {t: [] for t in range(num_tenants)}
+
+    def next_slice(t):
+        start = cursors[t]
+        cursors[t] = start + round_matches
+        w = streams[t][0][start : start + round_matches]
+        l = streams[t][1][start : start + round_matches]
+        history[t].append((w, l))
+        return w, l
+
+    def batched_round(active):
+        ws, ls = [], []
+        for t in range(active):
+            if t == zero_tenant:
+                continue
+            w, l = next_slice(t)
+            ws.append(tenancy.compose_ids(w, t, players))
+            ls.append(tenancy.compose_ids(l, t, players))
+        eng.ingest(np.concatenate(ws), np.concatenate(ls))
+
+    # --- phase 1: warmup, then within-bucket growth under the
+    # sentinel (the zero-recompile HARD gate) -------------------------
+    batched_round(grow_from)
+    jax.block_until_ready(eng.ratings)
+    sentinel = sanitize.RecompileSentinel(update=eng.num_compiles)
+    for target in grow_steps:
+        eng.ensure_tenants(target)
+        batched_round(target)
+    batched_round(num_tenants)  # warm-all: every tenant seen pre-timing
+    jax.block_until_ready(eng.ratings)
+    grew = sentinel.new_compiles()
+    if grew:
+        raise TenantGateError(
+            f"tenant growth {grow_from} -> {num_tenants} inside one "
+            f"tenant bucket ({bucket}) recompiled: {grew}; within-bucket "
+            "growth is bookkeeping only — the tenant axis is pow2-padded "
+            "exactly so new tenants never change a jitted shape"
+        )
+
+    # --- phase 2: the timed batched rounds ---------------------------
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        batched_round(num_tenants)
+    jax.block_until_ready(eng.ratings)
+    batched_s = time.perf_counter() - t0
+    grew = sentinel.new_compiles()
+    if grew:
+        raise TenantGateError(
+            f"steady-state batched tenant rounds recompiled: {grew}; "
+            "every round is (tenant_bucket, row_bucket)-shaped, so the "
+            "fused update was compiled at warmup and must stay compiled"
+        )
+
+    # --- phase 3: the dedicated loop (one engine per tenant; replay
+    # warmup excluded from timing) + the bit-exact HARD gate ----------
+    batched_ratings = np.asarray(eng.ratings)
+    dedicated_s = 0.0
+    mismatched = []
+    max_diff = 0.0
+    for t in range(num_tenants):
+        ded = engine.ArenaEngine(players, min_bucket=row_bucket, obs=None)
+        hist = history[t]
+        warm, timed = hist[: len(hist) - rounds], hist[len(hist) - rounds:]
+        for w, l in warm:
+            ded.ingest(w, l)
+        jax.block_until_ready(ded.ratings)
+        t0 = time.perf_counter()
+        for w, l in timed:
+            ded.ingest(w, l)
+        jax.block_until_ready(ded.ratings)
+        dedicated_s += time.perf_counter() - t0
+        ded_ratings = np.asarray(ded.ratings)
+        if not np.array_equal(batched_ratings[t], ded_ratings):
+            mismatched.append(t)
+            max_diff = max(
+                max_diff,
+                float(np.abs(batched_ratings[t] - ded_ratings).max()),
+            )
+    if mismatched:
+        raise TenantGateError(
+            f"{len(mismatched)} tenant(s) diverged bitwise from their "
+            f"dedicated single-tenant engines (first: {mismatched[:4]}, "
+            f"max diff {max_diff:.9f}); the fused row-parallel update "
+            "promises BIT-EXACT per-tenant ratings, not a tolerance"
+        )
+
+    speedup = dedicated_s / batched_s if batched_s else float("inf")
+    if speedup < min_speedup:
+        raise TenantGateError(
+            f"batched multi-tenant ingest is only {speedup:.2f}x the "
+            f"{num_tenants}-engine dedicated loop (floor "
+            f"{min_speedup:g}x); one fused (tenant, row) dispatch must "
+            "beat per-tenant kernel launches or the tenancy layer has "
+            "no reason to exist"
+        )
+
+    # --- phase 4: the ops-plane HARD gate — ONE registry, tenant-
+    # labeled counters reconciling exactly ----------------------------
+    per_tenant = obs.registry.counter_by_label(
+        "arena_tenant_matches_total", "tenant"
+    )
+    expected = {
+        str(t): round_matches * len(history[t])
+        for t in range(num_tenants)
+        if history[t]
+    }
+    if per_tenant != expected:
+        missing = sorted(set(expected) - set(per_tenant), key=int)[:4]
+        wrong = sorted(
+            (k for k in per_tenant if per_tenant[k] != expected.get(k)),
+            key=int,
+        )[:4]
+        raise TenantGateError(
+            f"the tenant-labeled ops plane does not reconcile: "
+            f"{len(per_tenant)} labeled series vs {len(expected)} active "
+            f"tenants (missing e.g. {missing}, wrong e.g. {wrong}); one "
+            "registry must account for every tenant's matches"
+        )
+
+    timed_matches = rounds * round_matches * (num_tenants - 1)
+    return {
+        "metric": "arena_tenant",
+        "value": round(speedup, 2),
+        "unit": "x_vs_dedicated_engines",
+        "vs_baseline": None,
+        "params": {
+            "tenants": num_tenants,
+            "players_per_tenant": players,
+            "round_matches": round_matches,
+            "rounds": rounds,
+            "seed": seed,
+            "grow_from": grow_from,
+            "tenant_bucket": bucket,
+            "row_bucket": row_bucket,
+            "min_speedup": min_speedup,
+            "host_cores": os.cpu_count() or 1,
+        },
+        "tenant": {
+            "batched_s": round(batched_s, 6),
+            "dedicated_s": round(dedicated_s, 6),
+            "timed_matches": timed_matches,
+            "batched_matches_per_s": round(timed_matches / batched_s)
+            if batched_s else None,
+            "growth_steps": grow_steps,
+            "steady_state_new_compiles": 0,  # sentinel gate raised otherwise
+            "bit_exact_tenants": num_tenants,
+            "zero_match_tenant": zero_tenant,
+            "ops_plane_tenants_labeled": len(per_tenant),
+        },
+        "equivalence_ok": True,
+        "max_rating_diff": 0.0,  # np.array_equal per tenant, gated above
+    }
+
+
 def main() -> int:
     rc = 0
     mode = os.environ.get("ARENA_BENCH_MODE", "elo")
@@ -2233,6 +2485,7 @@ def main() -> int:
         "soak": (run_soak_benchmark, "p99_query_latency_ms"),
         "frontend": (run_frontend_benchmark, "wire_queries_per_s"),
         "replica": (run_replica_benchmark, "replica_queries_per_s"),
+        "tenant": (run_tenant_benchmark, "x_vs_dedicated_engines"),
     }
     runner, unit = runners.get(mode, (run_benchmark, "x_vs_naive_baseline"))
     try:
@@ -2309,6 +2562,21 @@ def main() -> int:
         line = json.dumps(
             {
                 "metric": "arena_bench_replica_gate_failure",
+                "value": -1,
+                "unit": unit,
+                "vs_baseline": None,
+                "error": str(exc),
+                "debug_bundle": _gate_debug_bundle(mode),
+            }
+        )
+        rc = EXIT_EQUIVALENCE_FAILURE
+    except TenantGateError as exc:
+        # A tenancy contract broke (speedup floor, per-tenant bit-
+        # exactness, within-bucket recompile, ops-plane reconciliation):
+        # a measured verdict, never a crash.
+        line = json.dumps(
+            {
+                "metric": "arena_bench_tenant_gate_failure",
                 "value": -1,
                 "unit": unit,
                 "vs_baseline": None,

@@ -36,6 +36,7 @@ The host-side half of the hot path. Three jobs:
    order is preserved no matter how the two are mixed.
 """
 
+import operator
 import threading
 from functools import partial
 from typing import NamedTuple
@@ -99,6 +100,28 @@ def _validate_matches(num_players, winners, losers):
             )
 
 
+def _validate_tenant(num_tenants, tenant):
+    """Wire-input sanitizer for the tenant key — the tenancy analogue
+    of `_validate_matches`. An unknown tenant must be a reject at
+    admission: past this point the id becomes a composite-space offset,
+    and an out-of-range tenant would silently fold its matches into a
+    neighboring tenant's leaderboard."""
+    try:
+        t = operator.index(tenant)  # ints and np ints; no floats/strings
+    except TypeError:
+        raise ValueError(
+            f"tenant must be an integer, got {tenant!r}"
+        ) from None
+    if isinstance(tenant, bool):
+        raise ValueError(f"tenant must be an integer, got {tenant!r}")
+    if not 0 <= t < num_tenants:
+        raise ValueError(
+            f"unknown tenant {t}: this arena serves tenants "
+            f"[0, {num_tenants})"
+        )
+    return t
+
+
 def _group_by_player(combined, num_players):
     """Counting-sort grouping of a combined index array (host NumPy)."""
     order = np.argsort(combined, kind="stable").astype(np.int32)
@@ -108,10 +131,24 @@ def _group_by_player(combined, num_players):
     return order, bounds
 
 
-def pack_batch(num_players, winners, losers, min_bucket=MIN_BUCKET, dtype=np.float32):
-    """Pad one match batch to its bucket and precompute its grouping."""
+def pack_batch(num_players, winners, losers, min_bucket=MIN_BUCKET, dtype=np.float32,
+               tenant=0, players_per_tenant=None):
+    """Pad one match batch to its bucket and precompute its grouping.
+
+    `tenant=`/`players_per_tenant=` pack a tenant-local batch into the
+    composite id space (`tenant * players_per_tenant + player`) — the
+    grouping then keys on composite ids, so tenant is the leading sort
+    key for free (composite ids sort tenant-major). `num_players` is
+    always the COMPOSITE bound."""
     winners = np.asarray(winners, dtype=np.int32)
     losers = np.asarray(losers, dtype=np.int32)
+    if tenant:
+        if players_per_tenant is None:
+            raise ValueError("tenant != 0 requires players_per_tenant")
+        _validate_matches(players_per_tenant, winners, losers)
+        off = np.int32(int(tenant) * int(players_per_tenant))
+        winners = winners + off
+        losers = losers + off
     _validate_matches(num_players, winners, losers)
     n = winners.shape[0]
     b = bucket_size(n, min_bucket)
@@ -203,6 +240,13 @@ class ArenaEngine:  # protocol: shutdown
     the moment the update is dispatched, and XLA reuses it in place.
     """
 
+    # Single-tenant by default: tenant 0 is the whole arena. The
+    # multi-tenant subclass (arena.tenancy.MultiTenantEngine) widens
+    # these and re-routes the update through the fused per-tenant
+    # kernel; the shared ingest SIGNATURE carries `tenant=` everywhere
+    # so the front door / wire never special-case the engine flavor.
+    num_tenants = 1
+
     def __init__(
         self,
         num_players,
@@ -216,6 +260,9 @@ class ArenaEngine:  # protocol: shutdown
         if num_players < 2:
             raise ValueError("an arena needs at least two players")
         self.num_players = num_players
+        # Per-tenant roster size == the whole roster when single-tenant
+        # (the multi-tenant subclass narrows it to its per-tenant P).
+        self.players_per_tenant = num_players
         self.k = k
         self.scale = scale
         self.base = base
@@ -321,8 +368,10 @@ class ArenaEngine:  # protocol: shutdown
             self.matches_applied = store.num_matches
         return self.ratings
 
-    def update(self, winners, losers):  # deterministic; mutates: _store, ratings, matches_applied
+    def update(self, winners, losers, tenant=None):  # deterministic; mutates: _store, ratings, matches_applied
         """Ingest one batch of outcomes and apply one batched Elo round."""
+        if tenant is not None:
+            _validate_tenant(self.num_tenants, tenant)
         self._drain_pipeline()
         # Root span: this batch's trace id — every nested stage span
         # (store add, jit dispatch) parents under it (arena.obs.context).
@@ -356,7 +405,7 @@ class ArenaEngine:  # protocol: shutdown
             finally:
                 self._staging.release()
 
-    def ingest(self, winners, losers):  # deterministic; mutates: _store, _staging, ratings, matches_applied
+    def ingest(self, winners, losers, tenant=None):  # deterministic; mutates: _store, _staging, ratings, matches_applied
         """`update` on the incremental path: the batch is packed
         through reusable double-buffered staging slots (zero host
         allocations and zero new jit compiles in steady state) and
@@ -365,6 +414,8 @@ class ArenaEngine:  # protocol: shutdown
         being re-grouped from scratch at the next refit. Identical
         rating semantics to `update` — same jitted function, same
         packed layout — pinned by tests."""
+        if tenant is not None:
+            _validate_tenant(self.num_tenants, tenant)
         self._drain_pipeline()
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
@@ -414,7 +465,7 @@ class ArenaEngine:  # protocol: shutdown
         self._pipeline = pipeline_mod.IngestPipeline(self, **kwargs)
         return self._pipeline
 
-    def ingest_async(self, winners, losers, producer=None):
+    def ingest_async(self, winners, losers, producer=None, tenant=None):
         """`ingest` through the overlapped pipeline: the batch is
         validated HERE (a malformed batch raises at the call site, no
         state change) and handed to the background packer thread;
@@ -427,6 +478,8 @@ class ArenaEngine:  # protocol: shutdown
         original producer through). Returns the number of batches
         still pending (0 means everything submitted so far has
         applied)."""
+        if tenant is not None:
+            _validate_tenant(self.num_tenants, tenant)
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
         _validate_matches(self.num_players, w, l)

@@ -111,20 +111,24 @@ def render_query_payload(srv, view, stale, endpoint, params,
     honesty outranks cacheability there, and they are never cached."""
     if staleness is None:
         staleness = view.matches_ingested - view.watermark
+    # Tenant rides the parsed params (present only when the request
+    # carried `?tenant=`), so the byte-cache key — (endpoint, sorted
+    # params) — distinguishes tenants with no cache-side logic at all.
+    tenant = params.get("tenant")
     if endpoint == "leaderboard":
         return srv._query_parts(
             view, stale, (params["offset"], params["limit"]), None, None,
-            0, staleness=staleness,
+            0, staleness=staleness, tenant=tenant,
         )
     if endpoint == "player":
         return srv._query_parts(
             view, stale, None, [params["player"]], None, 0,
-            staleness=staleness,
+            staleness=staleness, tenant=tenant,
         )
     if endpoint == "h2h":
         return srv._query_parts(
             view, stale, None, None, [(params["a"], params["b"])], 0,
-            staleness=staleness,
+            staleness=staleness, tenant=tenant,
         )
     raise ValueError(f"endpoint {endpoint!r} is not cacheable")
 

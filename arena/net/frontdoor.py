@@ -315,17 +315,31 @@ class FrontDoor:
 
     # --- admission (any producer thread) ------------------------------
 
-    def admit(self, winners, losers, producer="local"):
+    def admit(self, winners, losers, producer="local", tenant=None):
         """Phase 1: validate the batch and assign its global sequence
         number — the batch's slot in the total order. Raises at the
-        call site on malformed input with no state change."""
+        call site on malformed input with no state change.
+
+        A `tenant` rewrites the batch's per-tenant-local player ids
+        into the engine's composite id space HERE, at admission — the
+        ticket, the applied log, and the spill all carry composite ids,
+        so every downstream stage (merge order, shedding, replication,
+        replay) is tenant-oblivious and unchanged."""
         if not producer or not isinstance(producer, str):
             raise ValueError(
                 f"producer label must be a non-empty str, got {producer!r}"
             )
         w = np.asarray(winners, np.int32)
         l = np.asarray(losers, np.int32)
-        engine_mod._validate_matches(self._eng.num_players, w, l)
+        if tenant is not None:
+            tenant = engine_mod._validate_tenant(self._eng.num_tenants, tenant)
+            ppt = self._eng.players_per_tenant
+            engine_mod._validate_matches(ppt, w, l)
+            off = np.int32(tenant * ppt)
+            w = w + off
+            l = l + off
+        else:
+            engine_mod._validate_matches(self._eng.num_players, w, l)
         ctx = trace_context.current()  # the request's root (or None)
         with self._cv:
             if self._closed:
@@ -362,10 +376,10 @@ class FrontDoor:
         obs.event("queue_depth", depth=depth, producer=ticket.producer)
         return ticket.seq
 
-    def submit(self, winners, losers, producer="local"):
+    def submit(self, winners, losers, producer="local", tenant=None):
         """admit + deliver in one call (the HTTP handler's form).
         Returns the batch's sequence number."""
-        return self.deliver(self.admit(winners, losers, producer))
+        return self.deliver(self.admit(winners, losers, producer, tenant=tenant))
 
     # --- the shedding policy (runs under the lock) --------------------
 

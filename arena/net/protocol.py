@@ -79,6 +79,15 @@ def _query_opt_int(params, key):
     return _query_int(params, key)
 
 
+def _parse_tenant(params, parsed):  # schema: wire-read-params@v1
+    """Fold an optional `?tenant=` into a read endpoint's parsed params
+    — included ONLY when present, so single-tenant requests parse (and
+    byte-cache-key) exactly as before the tenant axis existed."""
+    tenant = _query_opt_int(params, "tenant")
+    if tenant is not None:
+        parsed["tenant"] = tenant
+
+
 def parse_path(method, path):
     """Map (method, raw path) onto (endpoint, params) or raise
     `ProtocolError` with the status an unmatched request deserves:
@@ -103,6 +112,7 @@ def parse_path(method, path):
         as_of = _query_opt_int(params, "as_of")
         if as_of is not None:
             parsed["as_of"] = as_of
+        _parse_tenant(params, parsed)
     elif route == "player" and len(parts) == 2:
         endpoint, want = "player", "GET"
         try:
@@ -114,9 +124,11 @@ def parse_path(method, path):
         as_of = _query_opt_int(params, "as_of")
         if as_of is not None:
             parsed["as_of"] = as_of
+        _parse_tenant(params, parsed)
     elif route == "h2h" and len(parts) == 1:
         endpoint, want = "h2h", "GET"
         parsed = {"a": _query_int(params, "a"), "b": _query_int(params, "b")}
+        _parse_tenant(params, parsed)
     elif route == "submit" and len(parts) == 1:
         endpoint, want = "submit", "POST"
         parsed = {}
@@ -159,12 +171,16 @@ def parse_path(method, path):
 
 
 def parse_submit_body(raw):  # schema: wire-submit-request@v1
-    """Validate a submit body into (winners, losers, producer).
+    """Validate a submit body into (winners, losers, producer, tenant,
+    category).
 
     The body is ``{"winners": [ints], "losers": [ints],
-    "producer": "name"?}``; array-shape/range validation beyond this
-    (equal length, ids in range) happens at admission in the front
-    door, where the engine's own reject posture applies."""
+    "producer": "name"?, "tenant": int?, "category": "name"?}``;
+    `tenant` addresses a tenant slot directly, `category` names one
+    through the server's category registry — one or the other, never
+    both. Array-shape/range validation beyond this (equal length, ids
+    in range, tenant known) happens at admission in the front door,
+    where the engine's own reject posture applies."""
     try:
         doc = json.loads(raw.decode("utf-8"))
     except (UnicodeDecodeError, ValueError) as exc:
@@ -176,6 +192,24 @@ def parse_submit_body(raw):  # schema: wire-submit-request@v1
         raise ProtocolError(
             400, f"producer must be a non-empty string, got {producer!r}"
         )
+    tenant = doc.get("tenant")
+    if tenant is not None and not _plain_int(tenant):
+        raise ProtocolError(
+            400, f"submit field 'tenant' must be an integer, got {tenant!r}"
+        )
+    category = doc.get("category")
+    if category is not None and (
+        not isinstance(category, str) or not category
+    ):
+        raise ProtocolError(
+            400,
+            f"submit field 'category' must be a non-empty string, "
+            f"got {category!r}",
+        )
+    if tenant is not None and category is not None:
+        raise ProtocolError(
+            400, "submit takes 'tenant' OR 'category', not both"
+        )
     out = []
     for key in ("winners", "losers"):
         ids = doc.get(key)
@@ -186,7 +220,7 @@ def parse_submit_body(raw):  # schema: wire-submit-request@v1
                 400, f"submit field {key!r} must be a list of integers"
             )
         out.append(np.asarray(ids, np.int32))
-    return out[0], out[1], producer
+    return out[0], out[1], producer, tenant, category
 
 
 def _plain_int(value):
@@ -223,12 +257,19 @@ def parse_query_body(raw):  # schema: wire-query-request@v1
     for i, q in enumerate(queries):
         if not isinstance(q, dict):
             raise ProtocolError(400, f"queries[{i}] must be a JSON object")
-        unknown = sorted(set(q) - {"leaderboard", "players", "pairs"})
+        unknown = sorted(set(q) - {"leaderboard", "players", "pairs",
+                                   "tenant"})
         if unknown:
             raise ProtocolError(
                 400, f"queries[{i}] has unknown fields: {unknown}"
             )
         spec = {}
+        if "tenant" in q:
+            if not _plain_int(q["tenant"]):
+                raise ProtocolError(
+                    400, f"queries[{i}].tenant must be an integer"
+                )
+            spec["tenant"] = q["tenant"]
         if "leaderboard" in q:
             page = q["leaderboard"]
             if (
@@ -262,7 +303,7 @@ def parse_query_body(raw):  # schema: wire-query-request@v1
                     400, f"queries[{i}].pairs must be a list of [a, b] pairs"
                 )
             spec["pairs"] = [(p[0], p[1]) for p in pairs]
-        if not spec:
+        if not set(spec) & {"leaderboard", "players", "pairs"}:
             raise ProtocolError(400, f"queries[{i}] names no lookups")
         specs.append(spec)
     return specs
@@ -351,13 +392,22 @@ class WireClient:
         index-aligned with it, every entry answered from one view."""
         return self.post("/query", {"queries": list(queries)})
 
-    def submit(self, winners, losers, producer="local"):  # schema: wire-submit-request@v1
-        """POST one batch to /submit (ids coerced to plain ints)."""
-        return self.post("/submit", {
+    def submit(self, winners, losers, producer="local", tenant=None,
+               category=None):  # schema: wire-submit-request@v1
+        """POST one batch to /submit (ids coerced to plain ints).
+        `tenant=` submits tenant-local ids to one tenant's arena;
+        `category=` names the tenant through the server's category
+        registry instead (one or the other)."""
+        doc = {
             "winners": [int(i) for i in np.asarray(winners).tolist()],
             "losers": [int(i) for i in np.asarray(losers).tolist()],
             "producer": producer,
-        })
+        }
+        if tenant is not None:
+            doc["tenant"] = int(tenant)
+        if category is not None:
+            doc["category"] = category
+        return self.post("/submit", doc)
 
     def close(self):
         if self._conn is not None:

@@ -61,6 +61,8 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import numpy as np
+
 from arena.net import fastpath, protocol
 from arena.net import frontdoor as frontdoor_mod
 
@@ -117,14 +119,20 @@ def _dispatch(wire, endpoint, params, body_raw):
         if "as_of" in params:
             return 200, _as_of_payload(wire, params)
         return 200, srv.query(
-            leaderboard=(params["offset"], params["limit"])
+            leaderboard=(params["offset"], params["limit"]),
+            tenant=params.get("tenant"),
         )
     if endpoint == "player":
         if "as_of" in params:
             return 200, _as_of_payload(wire, params)
-        return 200, srv.query(players=[params["player"]])
+        return 200, srv.query(
+            players=[params["player"]], tenant=params.get("tenant")
+        )
     if endpoint == "h2h":
-        return 200, srv.query(pairs=[(params["a"], params["b"])])
+        return 200, srv.query(
+            pairs=[(params["a"], params["b"])],
+            tenant=params.get("tenant"),
+        )
     if endpoint == "query":
         return 200, srv.query_batch(protocol.parse_query_body(body_raw))
     if endpoint == "submit":
@@ -185,14 +193,29 @@ def _submit(wire, body_raw):  # schema: wire-submit-response@v1
         raise protocol.ProtocolError(
             503, "this server has no front door (read-only replica)"
         )
-    winners, losers, producer = protocol.parse_submit_body(body_raw)
-    seq = frontdoor.submit(winners, losers, producer=producer)
-    return STATUS_ACCEPTED, {
+    winners, losers, producer, tenant, category = protocol.parse_submit_body(
+        body_raw
+    )
+    if category is not None:
+        if wire.categories is None:
+            raise protocol.ProtocolError(
+                400, "this server has no category registry: submit by "
+                "'tenant' instead"
+            )
+        # Registry resolution is the category's wire sanitizer: an
+        # unknown name is a ValueError -> 400, same reject posture as
+        # an unknown tenant at admission.
+        tenant = wire.categories.resolve(category)
+    seq = frontdoor.submit(winners, losers, producer=producer, tenant=tenant)
+    out = {
         "seq": seq,
         "producer": producer,
         "matches": int(winners.shape[0]),
         "pending_batches": frontdoor.pending_batches(),
     }
+    if tenant is not None:
+        out["tenant"] = int(tenant)
+    return STATUS_ACCEPTED, out
 
 
 def _log_payload(wire, params):  # schema: wire-log-segment@v1
@@ -222,6 +245,11 @@ def _log_payload(wire, params):  # schema: wire-log-segment@v1
         # A watermark that is not a record boundary: the replica must
         # re-seat its cursor — a conflict, not a malformed request.
         raise protocol.ProtocolError(409, str(exc)) from None
+    # The tenant column: log records carry COMPOSITE ids (what replicas
+    # replay verbatim), so each record's tenant is derived, not stored —
+    # the uniform tenant of its ids, or -1 for a record spanning several
+    # (a shed summary coalesces every producer's backlog).
+    ppt = wire.server.engine.players_per_tenant
     return {
         "records": [
             {
@@ -229,6 +257,7 @@ def _log_payload(wire, params):  # schema: wire-log-segment@v1
                 "kind": kind,
                 "winners": w.tolist(),
                 "losers": l.tolist(),
+                "tenant": _record_tenant(w, l, ppt),
                 "record_watermark": wm,
             }
             for seq, kind, w, l, wm in records
@@ -239,11 +268,26 @@ def _log_payload(wire, params):  # schema: wire-log-segment@v1
     }
 
 
+def _record_tenant(w, l, players_per_tenant):  # deterministic
+    """The uniform tenant of one log record's composite ids (0 for an
+    empty record, -1 for a multi-tenant summary)."""
+    if not w.shape[0]:
+        return 0
+    tenants = np.concatenate([w, l]) // players_per_tenant
+    t = int(tenants[0])
+    return t if bool((tenants == t).all()) else -1
+
+
 def _as_of_payload(wire, params):
     """Time-travel reads: `?as_of=<watermark>` answered by the
     configured `TimeTravelIndex` (nearest retained snapshot + shipped
     log replay), not the live view. The payload carries the HISTORICAL
     watermark, so the envelope is honest about which state answered."""
+    if "tenant" in params:
+        raise protocol.ProtocolError(
+            400, "time-travel reads answer from the composite index; "
+            "'tenant' and 'as_of' cannot be combined"
+        )
     index = wire.time_travel
     if index is None:
         raise protocol.ProtocolError(
@@ -274,9 +318,14 @@ class ArenaHTTPServer:  # protocol: start->close
                  cache_capacity=fastpath.DEFAULT_CACHE_CAPACITY,
                  prerender_pages=fastpath.DEFAULT_PRERENDER_PAGES,
                  submit_workers=fastpath.DEFAULT_SUBMIT_WORKERS,
-                 time_travel=None):
+                 time_travel=None, categories=None):
         self.server = server
         self.frontdoor = frontdoor
+        # Optional `arena.tenancy.CategoryRegistry`: lets /submit name
+        # a tenant by category ("coding", "creative-writing", ...) —
+        # the LMSYS per-category slice use-case. Without one, category
+        # submits answer 400.
+        self.categories = categories
         # Optional `arena.net.replica.TimeTravelIndex` (duck-typed:
         # anything with leaderboard/player as-of renderers); without
         # one, `?as_of=` reads answer 503.

@@ -125,6 +125,53 @@ def elo_batch_update_sorted(  # deterministic
     return ratings + sorted_segment_sum(signed, perm, bounds)
 
 
+def tenant_sorted_segment_sum(values, perm, bounds):  # deterministic
+    """Row-parallel `sorted_segment_sum`: one tenant per row.
+
+    `values` is (T, 2B) signed addends in match order, `perm` a (T, 2B)
+    per-row grouping permutation, `bounds` (T, P+1) per-row segment
+    starts. Each row's arithmetic — gather, cumsum along axis 1,
+    boundary differences — is the EXACT op sequence the 1-D kernel
+    runs on a (2B,) batch, so every tenant's segment sums are
+    bit-identical to a dedicated single-tenant dispatch over the same
+    padded layout (property-tested; the tenant bench hard-gates it).
+    One fused call replaces T Python dispatches — tenant is just one
+    more leading axis, the same trick the chunked BT path plays with
+    its chunk axis.
+    """
+    sv = jnp.take_along_axis(values, perm, axis=1)
+    cs = jnp.concatenate(
+        [jnp.zeros((values.shape[0], 1), values.dtype),
+         jnp.cumsum(sv, axis=1)],
+        axis=1,
+    )
+    return (
+        jnp.take_along_axis(cs, bounds[:, 1:], axis=1)
+        - jnp.take_along_axis(cs, bounds[:, :-1], axis=1)
+    )
+
+
+def elo_tenant_update_sorted(  # deterministic
+    ratings, winners, losers, valid, perm, bounds, k=DEFAULT_K, scale=DEFAULT_SCALE
+):
+    """One batched Elo round for EVERY tenant in one fused dispatch.
+
+    `ratings` is (T, P) — tenant-major, the multi-tenant engine's
+    native state. winners/losers/valid are (T, B) with tenant-LOCAL
+    player ids; perm (T, 2B) and bounds (T, P+1) are per-row groupings
+    over each row's concatenated [winners, losers] (built host-side in
+    `tenancy.pack_tenant_batch`). A tenant whose row is all padding
+    (valid == 0 everywhere) contributes signed zeros only, and
+    ``x + (±0.0) == x`` bitwise for every rating the engine can hold —
+    so idle tenants ride along for free, bit-exactly.
+    """
+    r_w = jnp.take_along_axis(ratings, winners, axis=1)
+    r_l = jnp.take_along_axis(ratings, losers, axis=1)
+    d = k * (1.0 - elo_expected(r_w, r_l, scale)) * valid
+    signed = jnp.concatenate([d, -d], axis=1)
+    return ratings + tenant_sorted_segment_sum(signed, perm, bounds)
+
+
 def elo_epoch(  # deterministic
     ratings, winners, losers, valid, perms, bounds, k=DEFAULT_K, scale=DEFAULT_SCALE
 ):

@@ -80,7 +80,13 @@ SNAPSHOT_MAGIC = b"ARENASNP"
 # compaction), and the manifest carries a chain link — the base's
 # checksum, watermark, and compaction count — that restore validates
 # hop by hop back to a full snapshot.
-SNAPSHOT_VERSION = 2
+# v3 (PR 19): multi-tenant arenas. The manifest carries the tenant
+# geometry (`num_tenants`, `players_per_tenant` — `num_players` stays
+# the COMPOSITE bound, so every v2 size/count invariant reads
+# unchanged) and the arrays gain a per-tenant match-count column
+# (`tenant_counts`); restore rebuilds a `MultiTenantEngine` whenever
+# the manifest says more than one tenant rode the stream.
+SNAPSHOT_VERSION = 3
 MANIFEST_NAME = "manifest.json"
 ARRAYS_NAME = "arrays.bin"
 _HEADER_BYTES = len(SNAPSHOT_MAGIC) + 4  # magic + uint32 version
@@ -108,7 +114,7 @@ class SnapshotError(RuntimeError):
     leaves a half-restored server."""
 
 
-def _array_entry(name, arr, offset):  # schema: arena-snapshot@v2
+def _array_entry(name, arr, offset):  # schema: arena-snapshot@v3
     return {
         "name": name,
         "dtype": arr.dtype.name,
@@ -118,7 +124,8 @@ def _array_entry(name, arr, offset):  # schema: arena-snapshot@v2
 
 
 def _check_base_compatible(base_manifest, *, num_players, k, scale, base,
-                           min_bucket, store_state):  # schema: incremental-manifest@v1
+                           min_bucket, store_state, num_tenants=1,
+                           players_per_tenant=None):  # schema: incremental-manifest@v2
     """An increment may only be cut against a base describing the SAME
     arena (players, rating hyperparameters, store tuning) at an
     earlier-or-equal point of the SAME stream. Raises SnapshotError —
@@ -131,6 +138,13 @@ def _check_base_compatible(base_manifest, *, num_players, k, scale, base,
         ("min_bucket", min_bucket),
         ("compact_threshold", int(store_state["compact_threshold"])),
         ("size_ratio", int(store_state["size_ratio"])),
+        # Tenant geometry (v3): a base with a different per-tenant
+        # roster size would silently re-slice every composite id —
+        # same-arena means same geometry. (`num_players` above already
+        # pins the tenant BUCKET; the tenant COUNT may grow within it
+        # between base and increment, checked below.)
+        ("players_per_tenant",
+         num_players if players_per_tenant is None else players_per_tenant),
     )
     for field, ours in pairs:
         theirs = base_manifest.get(field)
@@ -139,6 +153,12 @@ def _check_base_compatible(base_manifest, *, num_players, k, scale, base,
                 f"incremental base mismatch on {field!r}: base snapshot "
                 f"has {theirs!r}, live state has {ours!r}"
             )
+    if int(base_manifest.get("num_tenants", 1)) > num_tenants:
+        raise SnapshotError(
+            f"incremental base serves {base_manifest.get('num_tenants')} "
+            f"tenants, live state only {num_tenants} — tenants never "
+            "shrink on one stream"
+        )
     base_n = int(base_manifest.get("num_matches"))
     if base_n > int(store_state["num_matches"]):
         raise SnapshotError(
@@ -155,7 +175,8 @@ def _check_base_compatible(base_manifest, *, num_players, k, scale, base,
 
 def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
                    store_state, ratings, queue, base_manifest=None,
-                   base_ref=None):  # deterministic; schema: arena-snapshot@v2
+                   base_ref=None, num_tenants=1,
+                   players_per_tenant=None):  # deterministic; schema: arena-snapshot@v3
     """Write one snapshot directory: arrays.bin + manifest.json.
 
     `store_state` is `MergeableCSR.export_state()` output; `ratings` a
@@ -176,6 +197,19 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
     snapshot it reconstructs to.
     """
     path = pathlib.Path(path)
+    if players_per_tenant is None:
+        players_per_tenant = num_players
+    # Per-tenant match counts over the FULL stored log (full-state
+    # semantics even in an increment, like every other manifest count):
+    # the tenant column replicas and ops dashboards read without
+    # re-deriving composite ids.
+    tenant_counts = np.bincount(
+        np.asarray(store_state["winners"], np.int64) // players_per_tenant,
+        minlength=num_tenants,
+    ).astype(np.int32)
+    # A multi-tenant engine hands ratings in as (tenant_bucket, P); the
+    # serialized layout is always the flat composite vector.
+    ratings = np.ascontiguousarray(np.asarray(ratings).reshape(-1))
     queue_lengths = np.array([int(w.shape[0]) for w, _l in queue], np.int32)
     queue_w = (
         np.concatenate([w for w, _l in queue]).astype(np.int32)
@@ -194,6 +228,7 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
         _check_base_compatible(
             base_manifest, num_players=num_players, k=k, scale=scale,
             base=base, min_bucket=min_bucket, store_state=store_state,
+            num_tenants=num_tenants, players_per_tenant=players_per_tenant,
         )
         base_n = int(base_manifest["num_matches"])
         reuses_base_runs = (
@@ -233,6 +268,7 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
         ("delta_winners", delta_w),
         ("delta_losers", delta_l),
         ("ratings", np.asarray(ratings, np.float32)),
+        ("tenant_counts", tenant_counts),
         ("queue_lengths", queue_lengths),
         ("queue_winners", queue_w),
         ("queue_losers", queue_l),
@@ -252,6 +288,8 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
         "version": SNAPSHOT_VERSION,
         "kind": kind,
         "num_players": num_players,
+        "num_tenants": int(num_tenants),
+        "players_per_tenant": int(players_per_tenant),
         "num_matches": int(store_state["num_matches"]),
         "compactions": int(store_state["compactions"]),
         "compact_threshold": int(store_state["compact_threshold"]),
@@ -279,7 +317,7 @@ def write_snapshot(path, *, num_players, k, scale, base, min_bucket,
     return manifest
 
 
-def _read_manifest(path):  # deterministic; schema: arena-snapshot@v2
+def _read_manifest(path):  # deterministic; schema: arena-snapshot@v3
     """Load and gate one snapshot manifest (magic + version only —
     the cheap checks that do not need the array bytes). Cutting an
     increment reads its base through here without paying for the
@@ -307,7 +345,7 @@ def _read_manifest(path):  # deterministic; schema: arena-snapshot@v2
     return manifest
 
 
-def read_snapshot(path):  # deterministic; schema: arena-snapshot@v2
+def read_snapshot(path):  # deterministic; schema: arena-snapshot@v3
     """Validate and load one snapshot directory.
 
     Returns `(manifest, arrays)` with every array materialized as an
@@ -356,7 +394,8 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v2
             f"{manifest.get('checksum_sha256')}, arrays hash to {digest}"
         )
     for field in (
-        "num_players", "num_matches", "compactions", "compact_threshold",
+        "num_players", "num_tenants", "players_per_tenant", "num_matches",
+        "compactions", "compact_threshold",
         "size_ratio", "queue_batches", "queue_matches", "base_num_matches",
         "base_compactions", "delta_matches", "chain_depth",
     ):
@@ -427,7 +466,7 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v2
     required = {
         "keys", "pos", "tail_keys", "tail_pos", "tail_run_lengths",
         "winners", "losers", "delta_winners", "delta_losers", "ratings",
-        "queue_lengths", "queue_winners", "queue_losers",
+        "tenant_counts", "queue_lengths", "queue_winners", "queue_losers",
     }
     missing = required - set(arrays)
     if missing:
@@ -468,6 +507,24 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v2
             f"ratings vector holds {arrays['ratings'].size} players, "
             f"manifest promises {manifest.get('num_players')}"
         )
+    nt = manifest.get("num_tenants")
+    ppt = manifest.get("players_per_tenant")
+    if (nt < 1 or ppt < 1 or nt * ppt > manifest.get("num_players")
+            or manifest.get("num_players") % ppt):
+        raise SnapshotError(
+            f"tenant geometry {nt} tenants x {ppt} players does not fit "
+            f"the {manifest.get('num_players')}-player composite space"
+        )
+    if arrays["tenant_counts"].size != nt:
+        raise SnapshotError(
+            f"tenant_counts holds {arrays['tenant_counts'].size} tenants, "
+            f"manifest promises {nt}"
+        )
+    if int(arrays["tenant_counts"].sum()) != n:
+        raise SnapshotError(
+            f"tenant_counts sums to {int(arrays['tenant_counts'].sum())} "
+            f"matches, manifest promises {n}"
+        )
     qm = manifest.get("queue_matches")
     if (
         int(arrays["queue_lengths"].sum()) != qm
@@ -483,7 +540,7 @@ def read_snapshot(path):  # deterministic; schema: arena-snapshot@v2
     return manifest, arrays
 
 
-def _validate_chain_link(child, base_manifest, base_dir):  # deterministic; schema: incremental-manifest@v1
+def _validate_chain_link(child, base_manifest, base_dir):  # deterministic; schema: incremental-manifest@v2
     """Chain integrity: an increment must resolve against EXACTLY the
     base it was cut from. The link is pinned three ways — the base's
     arrays checksum (content identity), its watermark, and its
@@ -516,7 +573,7 @@ def _validate_chain_link(child, base_manifest, base_dir):  # deterministic; sche
         )
 
 
-def read_snapshot_chain(path):  # deterministic; schema: arena-snapshot@v2
+def read_snapshot_chain(path):  # deterministic; schema: arena-snapshot@v3
     """Resolve a snapshot — full or the head of an incremental chain —
     into fully materialized state.
 
@@ -566,6 +623,7 @@ def read_snapshot_chain(path):  # deterministic; schema: arena-snapshot@v2
         merged["tail_pos"] = link_arrays["tail_pos"]
         merged["tail_run_lengths"] = link_arrays["tail_run_lengths"]
         merged["ratings"] = link_arrays["ratings"]
+        merged["tenant_counts"] = link_arrays["tenant_counts"]
         merged["queue_lengths"] = link_arrays["queue_lengths"]
         merged["queue_winners"] = link_arrays["queue_winners"]
         merged["queue_losers"] = link_arrays["queue_losers"]
@@ -590,15 +648,30 @@ class ServingView:
     leaderboard pages slice; `wins`/`losses` are per-player counts
     from the cloned log. `lo`/`hi` are the bootstrap interval arrays
     current at build time (None until `refresh_intervals` runs).
+
+    Multi-tenant arenas serve per-tenant slices of this SAME view:
+    `ratings` is always the flat composite vector (a 2-D engine
+    snapshot is flattened on the way in), and `tenant_order(t)` is the
+    per-tenant leaderboard permutation over tenant t's local-id slice
+    — computed on first use and memoized for the view's lifetime, so
+    a view refresh never pays an argsort for a tenant nobody queried.
     """
 
     __slots__ = (
         "ratings", "watermark", "matches_ingested", "store", "order",
         "wins", "losses", "lo", "hi", "seq", "ratings_sum",
+        "num_tenants", "players_per_tenant", "_tenant_orders",
     )
 
-    def __init__(self, ratings, watermark, store, lo, hi, seq):
+    def __init__(self, ratings, watermark, store, lo, hi, seq,
+                 num_tenants=1, players_per_tenant=None):
+        ratings = np.asarray(ratings).reshape(-1)
         self.ratings = ratings
+        self.num_tenants = num_tenants
+        self.players_per_tenant = (
+            ratings.size if players_per_tenant is None else players_per_tenant
+        )
+        self._tenant_orders = {}
         self.watermark = watermark
         self.store = store
         self.matches_ingested = store.num_matches
@@ -612,6 +685,18 @@ class ServingView:
         self.lo = lo
         self.hi = hi
         self.seq = seq
+
+    def tenant_order(self, tenant):
+        """Descending-rating permutation of tenant `tenant`'s LOCAL id
+        slice (memoized per view; dict assignment is atomic under the
+        GIL, so concurrent first readers at worst both compute it)."""
+        order = self._tenant_orders.get(tenant)
+        if order is None:
+            off = tenant * self.players_per_tenant
+            row = self.ratings[off: off + self.players_per_tenant]
+            order = np.argsort(-row, kind="stable").astype(np.int32)
+            self._tenant_orders[tenant] = order
+        return order
 
 
 class ArenaServer:  # protocol: close
@@ -865,7 +950,11 @@ class ArenaServer:  # protocol: close
             store = self.engine._store.clone()
             lo, hi = self._intervals if self._intervals is not None else (None, None)
             self._seq += 1
-            self._view = ServingView(ratings, watermark, store, lo, hi, self._seq)
+            self._view = ServingView(
+                ratings, watermark, store, lo, hi, self._seq,
+                num_tenants=self.engine.num_tenants,
+                players_per_tenant=self.engine.players_per_tenant,
+            )
             self._c_view_refreshes.inc()
             self._observe_sanitizers()
             for listener in list(self._refresh_listeners):
@@ -938,7 +1027,7 @@ class ArenaServer:  # protocol: close
 
     # --- the batched query API ---------------------------------------
 
-    def query(self, leaderboard=None, players=None, pairs=None):
+    def query(self, leaderboard=None, players=None, pairs=None, tenant=None):
         """One batched query, every part answered from ONE view.
 
         leaderboard: (offset, limit) page of the descending-rating
@@ -947,6 +1036,11 @@ class ArenaServer:  # protocol: close
         from the view's ratings. Ids out of range raise ValueError
         (nothing is served). The response carries the view's
         watermark, its staleness at serve time, and the stale flag.
+
+        `tenant=` scopes EVERY part to that tenant's slice of the same
+        view: ids become tenant-local, the leaderboard pages the
+        per-tenant order. An unknown tenant is a reject (ValueError —
+        the wire's 400), same posture as an out-of-range player id.
         """
         t0 = time.perf_counter()
         # Root span: this query's trace id — the view build (when this
@@ -954,14 +1048,17 @@ class ArenaServer:  # protocol: close
         # histograms record it as the bucket exemplar, and
         # `obs.tracer.trace(id)` replays the whole request afterwards.
         with self.obs.span("serve.query") as qspan:
-            out = self._query_into(qspan, t0, leaderboard, players, pairs)
+            out = self._query_into(
+                qspan, t0, leaderboard, players, pairs, tenant
+            )
         return out
 
-    def _query_into(self, qspan, t0, leaderboard, players, pairs):
+    def _query_into(self, qspan, t0, leaderboard, players, pairs, tenant):
         view, stale = self._serve_view()
         self._c_queries.inc()
         out = self._query_parts(
-            view, stale, leaderboard, players, pairs, qspan.trace_id
+            view, stale, leaderboard, players, pairs, qspan.trace_id,
+            tenant=tenant,
         )
         # Latency + staleness distributions: the p50/p99 substrate the
         # soak bench (and the network tier) reports. Host-side work
@@ -997,7 +1094,7 @@ class ArenaServer:  # protocol: close
                     view, stale,
                     spec.get("leaderboard"), spec.get("players"),
                     spec.get("pairs"), qspan.trace_id,
-                    staleness=staleness,
+                    staleness=staleness, tenant=spec.get("tenant"),
                 ))
             self._c_queries.inc(len(results))
             latency = time.perf_counter() - t0
@@ -1013,7 +1110,7 @@ class ArenaServer:  # protocol: close
             }
 
     def _query_parts(self, view, stale, leaderboard, players, pairs,
-                     trace_id, staleness=None):  # schema: wire-query-response@v1
+                     trace_id, staleness=None, tenant=None):  # schema: wire-query-response@v1
         """Render one lookup's response parts against an already-chosen
         view. Deterministic in (view, arguments) apart from the
         engine's immutable Elo scale — the property the wire byte
@@ -1021,8 +1118,27 @@ class ArenaServer:  # protocol: close
         byte for byte. `staleness` defaults to the live ingest
         distance (the `query()` contract); the wire fast path passes
         the view-stable distance so cached bytes never embed a number
-        that drifts between identical renders."""
-        num_players = view.ratings.size
+        that drifts between identical renders.
+
+        `tenant=` selects one tenant's slice of the view: every id in
+        the arguments AND in the rendered rows is tenant-local, and the
+        leaderboard pages `view.tenant_order(tenant)`. None keeps the
+        composite-space behavior — on a single-tenant arena that IS the
+        arena; on a multi-tenant one it is the cross-tenant admin view."""
+        if tenant is None:
+            num_players = view.ratings.size
+            off = 0
+            order = view.order
+        else:
+            tenant = int(tenant)
+            if not 0 <= tenant < view.num_tenants:
+                raise ValueError(
+                    f"unknown tenant {tenant}: this arena serves tenants "
+                    f"[0, {view.num_tenants})"
+                )
+            num_players = view.players_per_tenant
+            off = tenant * num_players
+            order = view.tenant_order(tenant)
         out = {
             "watermark": view.watermark,
             # The request's trace id rides NEXT TO the watermark in
@@ -1039,6 +1155,8 @@ class ArenaServer:  # protocol: close
             "view_seq": view.seq,
             "view_ratings_sum": view.ratings_sum,
         }
+        if tenant is not None:
+            out["tenant"] = tenant
         if leaderboard is not None:
             offset, limit = leaderboard
             if offset < 0 or limit < 0:
@@ -1046,9 +1164,9 @@ class ArenaServer:  # protocol: close
                     f"leaderboard page must be non-negative, got "
                     f"({offset}, {limit})"
                 )
-            page = view.order[offset: offset + limit]
+            page = order[offset: offset + limit]
             out["leaderboard"] = [
-                self._player_row(view, int(p), rank=offset + i + 1)
+                self._player_row(view, int(p), rank=offset + i + 1, off=off)
                 for i, p in enumerate(page)
             ]
         if players is not None:
@@ -1059,7 +1177,9 @@ class ArenaServer:  # protocol: close
                 raise ValueError(
                     f"player ids must be in [0, {num_players})"
                 )
-            out["players"] = [self._player_row(view, int(p)) for p in ids]
+            out["players"] = [
+                self._player_row(view, int(p), off=off) for p in ids
+            ]
         if pairs is not None:
             rows = []
             for a, b in pairs:
@@ -1071,22 +1191,24 @@ class ArenaServer:  # protocol: close
                     "a": int(a),
                     "b": int(b),
                     "p_a_beats_b": _elo_win_prob(
-                        float(view.ratings[a]),
-                        float(view.ratings[b]),
+                        float(view.ratings[off + a]),
+                        float(view.ratings[off + b]),
                         self.engine.scale,
                     ),
                 })
             out["pairs"] = rows
         return out
 
-    def _player_row(self, view, p, rank=None):  # pure-render(view); schema: wire-player-row@v1
+    def _player_row(self, view, p, rank=None, off=0):  # pure-render(view); schema: wire-player-row@v1
+        """`off` is the tenant's composite-space offset: rows report the
+        TENANT-LOCAL id, reads index the composite arrays."""
         row = {
             "player": p,
-            "rating": float(view.ratings[p]),
-            "lo": None if view.lo is None else float(view.lo[p]),
-            "hi": None if view.hi is None else float(view.hi[p]),
-            "wins": int(view.wins[p]),
-            "losses": int(view.losses[p]),
+            "rating": float(view.ratings[off + p]),
+            "lo": None if view.lo is None else float(view.lo[off + p]),
+            "hi": None if view.hi is None else float(view.hi[off + p]),
+            "wins": int(view.wins[off + p]),
+            "losses": int(view.losses[off + p]),
         }
         if rank is not None:
             row["rank"] = rank
@@ -1094,7 +1216,7 @@ class ArenaServer:  # protocol: close
 
     # --- snapshot / restore ------------------------------------------
 
-    def snapshot(self, path, spill=False, base=None):  # schema: arena-snapshot@v2
+    def snapshot(self, path, spill=False, base=None):  # schema: arena-snapshot@v3
         """Spill the engine to a durable snapshot directory.
 
         Default: the async pipeline (if any) is DRAINED first
@@ -1163,11 +1285,13 @@ class ArenaServer:  # protocol: close
                 queue=queue,
                 base_manifest=base_manifest,
                 base_ref=base_ref,
+                num_tenants=eng.num_tenants,
+                players_per_tenant=eng.players_per_tenant,
             )
             self._c_snapshots.inc()
             return manifest
 
-    def restore(self, path):  # schema: arena-snapshot@v2
+    def restore(self, path):  # schema: arena-snapshot@v3
         """Reload a snapshot — full or incremental head — and resume
         mid-stream.
 
@@ -1186,14 +1310,37 @@ class ArenaServer:  # protocol: close
             with self.obs.span("serve.restore"):
                 manifest, arrays = read_snapshot_chain(path)
                 store = self._assemble_store(manifest, arrays)
-                eng = ArenaEngine(
-                    manifest["num_players"],
-                    k=manifest["k"],
-                    scale=manifest["scale"],
-                    base=manifest["base"],
-                    min_bucket=manifest["min_bucket"],
-                    obs=self.obs,
-                )
+                if manifest.get("num_tenants", 1) > 1:
+                    # Imported lazily: arena.tenancy imports this
+                    # module's engine primitives at its own top level.
+                    from arena.tenancy import MultiTenantEngine
+
+                    # Pin the tenant bucket to exactly the written
+                    # geometry (num_players is tenant_bucket * ppt), so
+                    # a restored engine's composite space — and every
+                    # stored composite id — lines up bit-for-bit.
+                    eng = MultiTenantEngine(
+                        manifest["players_per_tenant"],
+                        num_tenants=manifest["num_tenants"],
+                        k=manifest["k"],
+                        scale=manifest["scale"],
+                        base=manifest["base"],
+                        min_bucket=manifest["min_bucket"],
+                        obs=self.obs,
+                        min_tenant_bucket=(
+                            manifest["num_players"]
+                            // manifest["players_per_tenant"]
+                        ),
+                    )
+                else:
+                    eng = ArenaEngine(
+                        manifest["num_players"],
+                        k=manifest["k"],
+                        scale=manifest["scale"],
+                        base=manifest["base"],
+                        min_bucket=manifest["min_bucket"],
+                        obs=self.obs,
+                    )
                 eng.adopt_state(arrays["ratings"], store)
                 queue = _split_queue(arrays)
                 with self._lock:
@@ -1212,7 +1359,7 @@ class ArenaServer:  # protocol: close
         self.refresh_view()
         return manifest
 
-    def _assemble_store(self, manifest, arrays):  # schema: arena-snapshot@v2
+    def _assemble_store(self, manifest, arrays):  # schema: arena-snapshot@v3
         """`MergeableCSR.from_state` with its ValueErrors upgraded to
         the snapshot-reject contract (distinct error, nothing
         installed). The delta tail is restored AS RUNS — dropping it
@@ -1246,7 +1393,7 @@ class ArenaServer:  # protocol: close
         self.engine.shutdown()
 
 
-def _split_queue(arrays):  # schema: arena-snapshot@v2
+def _split_queue(arrays):  # schema: arena-snapshot@v3
     lengths = arrays["queue_lengths"]
     if not lengths.size:
         return []

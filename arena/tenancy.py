@@ -1,0 +1,384 @@
+"""Multi-tenant arenas: thousands of leaderboards through one jitted kernel.
+
+ROADMAP item 4's scale move. Today a second leaderboard means a second
+`ArenaEngine` — a second jit cache, a second ops plane, and one Python
+dispatch per tenant per round (exactly the naive-loop tax PR 1 measured
+at 55–70x). This module makes tenant one more segment key:
+
+- **Composite ids.** A match for tenant ``t`` between local players
+  ``(w, l)`` is stored as ``(t * num_players + w, t * num_players + l)``
+  — the `MergeableCSR` keeps ONE tenant-major sorted grouping (tenant
+  is the leading sort key by construction), and the composite space is
+  what the chunked Bradley–Terry refit and the bootstrap resampler
+  already consume unchanged.
+
+- **The fused update.** Elo rounds do NOT ride the flat composite
+  cumsum (cross-tenant prefix coupling would change each tenant's
+  float accumulation order). `MultiTenantEngine` keeps ratings as a
+  ``(tenant_bucket, num_players)`` matrix and dispatches
+  `ratings.elo_tenant_update_sorted`: per-row grouping, per-row cumsum
+  — every tenant's arithmetic is the exact op sequence a dedicated
+  single-tenant engine runs, so per-tenant results are bit-identical
+  to T dedicated engines fed the same per-round batches (the tenant
+  bench hard-gates this at 256 tenants; a property test covers zero-
+  match tenants and tenant-bucket growth).
+
+- **Bucketed tenant count.** The tenant axis is padded to a power of
+  two (`tenant_bucket`), so adding tenants WITHIN a bucket changes no
+  jit-boundary shape — zero steady-state recompiles, the same
+  born-shape-bucketed discipline `engine.pack_batch` applies to batch
+  sizes (jaxlint's `unbucketed-shape-at-jit-boundary` checks both).
+
+Bit-exactness contract: a tenant's ratings match a dedicated
+`ArenaEngine` when both pack each round into the SAME row bucket —
+construct the dedicated engine with the same `min_bucket` and keep
+per-round per-tenant batch sizes within one bucket (XLA's blocked
+cumsum is not padding-invariant past an insertion point, so differing
+buckets mean differing — still correct, not bit-equal — floats).
+
+`CategoryRegistry` maps category names ("coding", "creative-writing",
+…) onto tenant slots so per-category leaderboards — the LMSYS slice
+use-case — ride the same key with no extra kernel code.
+"""
+
+import threading
+from functools import partial
+
+import jax
+import numpy as np
+from jax import numpy as jnp
+
+from arena import ratings as R
+from arena.engine import (
+    ArenaEngine,
+    MIN_BUCKET,
+    _pow2_ceil,
+    _validate_matches,
+    _validate_tenant,
+    bucket_size,
+)
+
+# Tenant-count buckets start here: an arena born with 3 tenants is
+# shaped for 8, so early growth never touches a jit boundary.
+MIN_TENANT_BUCKET = 8
+
+
+def tenant_bucket(num_tenants, min_bucket=MIN_TENANT_BUCKET):  # deterministic
+    """Pow2 tenant-count bucket (the tenant-axis analogue of
+    `engine.bucket_size`): the jitted update's leading dim, so tenant
+    growth within a bucket is shape-invisible to XLA."""
+    return max(min_bucket, _pow2_ceil(max(int(num_tenants), 1)))
+
+
+def compose_ids(ids, tenant, players_per_tenant):  # deterministic
+    """Tenant-composite segment ids: the store/BT-side key. Tenant is
+    the leading sort key because the composite id sorts tenant-major."""
+    return ids + np.int32(tenant * players_per_tenant)
+
+
+class TenantPackedBatch:
+    """One round packed tenant-major for the fused 2-D update."""
+
+    __slots__ = ("winners", "losers", "valid", "perm", "bounds",
+                 "num_real", "tenant_counts")
+
+    def __init__(self, winners, losers, valid, perm, bounds, num_real,
+                 tenant_counts):
+        self.winners = winners
+        self.losers = losers
+        self.valid = valid
+        self.perm = perm
+        self.bounds = bounds
+        self.num_real = num_real
+        self.tenant_counts = tenant_counts
+
+
+def pack_tenant_batch(num_tenants_bucket, players_per_tenant, winners,
+                      losers, min_bucket=MIN_BUCKET, dtype=np.float32):  # deterministic
+    """Group one composite-id batch into the (T, B) tenant-major layout.
+
+    `winners`/`losers` carry COMPOSITE ids (any tenant mix; a match
+    must stay within one tenant — cross-tenant pairs are a reject).
+    Each tenant's matches land in its row in arrival order, padded to
+    the shared row bucket B exactly as `engine.pack_batch` pads a
+    single batch (real entries first, id-0 padding after, valid mask
+    0); the per-row grouping is the same stable argsort + boundary
+    layout `engine._group_by_player` builds, vectorized across rows —
+    no per-tenant Python loop, which is where the >= 5x over the
+    dedicated-engine loop comes from.
+    """
+    w = np.asarray(winners, np.int32)
+    l = np.asarray(losers, np.int32)
+    ppt = int(players_per_tenant)
+    t_w = w // ppt
+    if not np.array_equal(t_w, l // ppt):
+        raise ValueError(
+            "cross-tenant match: winner and loser must belong to the "
+            "same tenant"
+        )
+    n = int(w.shape[0])
+    T = int(num_tenants_bucket)
+    counts = np.bincount(t_w, minlength=T).astype(np.int64)
+    B = bucket_size(max(int(counts.max()) if n else 1, 1), min_bucket)
+    # Stable sort by tenant keeps each tenant's matches in batch order;
+    # the column index is the within-tenant arrival position.
+    order = np.argsort(t_w, kind="stable")
+    rows = t_w[order]
+    ends = np.cumsum(counts)
+    col = np.arange(n, dtype=np.int64) - np.repeat(ends - counts, counts)
+    w2 = np.zeros((T, B), np.int32)
+    l2 = np.zeros((T, B), np.int32)
+    valid = np.zeros((T, B), dtype)
+    w2[rows, col] = (w - t_w * ppt)[order]
+    l2[rows, col] = (l - t_w * ppt)[order]
+    valid[rows, col] = 1
+    combined = np.concatenate([w2, l2], axis=1)
+    perm = np.argsort(combined, axis=1, kind="stable").astype(np.int32)
+    # bounds[t, p] = count of entries with local id < p in row t ==
+    # searchsorted(sorted row, p, side="left"), vectorized by counting
+    # composite offsets into one flat bincount.
+    flat = (combined.astype(np.int64) +
+            np.arange(T, dtype=np.int64)[:, None] * ppt).ravel()
+    per_id = np.bincount(flat, minlength=T * ppt).reshape(T, ppt)
+    bounds = np.zeros((T, ppt + 1), np.int64)
+    np.cumsum(per_id, axis=1, out=bounds[:, 1:])
+    return TenantPackedBatch(
+        w2, l2, valid, perm, bounds.astype(np.int32), n, counts
+    )
+
+
+class MultiTenantEngine(ArenaEngine):
+    """N tenants, ONE engine: one jit cache, one store, one ops plane.
+
+    `num_players` is the PER-TENANT roster size; the composite player
+    space (`tenant_bucket * num_players` ids) is what the inherited
+    store, Bradley–Terry refits (`bt_strengths`, `refit_incremental` —
+    composite ids straight through `sorted_segment_sum`/`bt_mm_step`),
+    and bootstrap intervals operate on unchanged. Only the Elo update
+    is re-routed: batches pack tenant-major (`pack_tenant_batch`) and
+    dispatch the fused `elo_tenant_update_sorted`, so `ratings` is a
+    ``(tenant_bucket, num_players)`` matrix whose rows are bit-exact
+    dedicated-engine results.
+
+    The engine-facing ingest surface speaks composite ids (what the
+    front door, the applied log, and snapshot replay carry); pass
+    ``tenant=`` to submit tenant-local ids instead.
+    """
+
+    def __init__(self, num_players, num_tenants=1, k=R.DEFAULT_K,
+                 scale=R.DEFAULT_SCALE, base=R.DEFAULT_BASE,
+                 min_bucket=MIN_BUCKET, dtype=jnp.float32, obs=None,
+                 min_tenant_bucket=MIN_TENANT_BUCKET):
+        if num_tenants < 1:
+            raise ValueError(
+                f"a multi-tenant arena needs >= 1 tenant, got {num_tenants}"
+            )
+        bucket = tenant_bucket(num_tenants, min_tenant_bucket)
+        super().__init__(
+            bucket * num_players, k=k, scale=scale, base=base,
+            min_bucket=min_bucket, dtype=dtype, obs=obs,
+        )
+        self.players_per_tenant = num_players
+        self.num_tenants = num_tenants
+        self.tenant_bucket = bucket
+        self._min_tenant_bucket = min_tenant_bucket
+        # Born shape-bucketed: (tenant_bucket, players) from the first
+        # dispatch — never (num_tenants, players) reshaped later.
+        self.ratings = self.ratings.reshape(bucket, num_players)
+        self._update = jax.jit(
+            partial(R.elo_tenant_update_sorted, k=k, scale=scale),
+            donate_argnums=(0,),
+        )
+
+    # --- tenant roster -----------------------------------------------
+
+    def ensure_tenants(self, num_tenants):  # deterministic; mutates: num_tenants, tenant_bucket, num_players, ratings
+        """Grow the tenant roster to (at least) `num_tenants`.
+
+        Within the current bucket this is a bookkeeping write — no
+        shape changes, no recompiles (the tenant bench's sentinel
+        gate). Crossing the bucket pads the ratings matrix with fresh
+        base-rating rows and widens the store's composite bound; the
+        next dispatch compiles once for the new bucket, and existing
+        tenants' rows (and their composite ids, which depend only on
+        `players_per_tenant`) are untouched — bit-preserved."""
+        want = int(num_tenants)
+        if want <= self.num_tenants:
+            return self.num_tenants
+        new_bucket = tenant_bucket(want, self._min_tenant_bucket)
+        if new_bucket != self.tenant_bucket:
+            self._drain_pipeline()
+            pad = jnp.full(
+                (new_bucket - self.tenant_bucket, self.players_per_tenant),
+                self.base, self._dtype,
+            )
+            with self._view_lock:
+                self.ratings = jnp.concatenate([self.ratings, pad])
+                self.tenant_bucket = new_bucket
+                self.num_players = new_bucket * self.players_per_tenant
+                # The store's composite bound follows the bucket; every
+                # already-stored id stays valid (ids only grow upward).
+                self._store.num_players = self.num_players
+        self.num_tenants = want
+        return self.num_tenants
+
+    def _compose(self, winners, losers, tenant):
+        """Map (tenant-local ids, tenant) onto validated composite ids;
+        tenant=None passes composite ids through."""
+        w = np.asarray(winners, np.int32)
+        l = np.asarray(losers, np.int32)
+        if tenant is not None:
+            t = _validate_tenant(self.num_tenants, tenant)
+            _validate_matches(self.players_per_tenant, w, l)
+            w = compose_ids(w, t, self.players_per_tenant)
+            l = compose_ids(l, t, self.players_per_tenant)
+        else:
+            _validate_matches(self.num_players, w, l)
+        return w, l
+
+    # --- the fused update path ---------------------------------------
+
+    def _apply_tenant(self, packed):
+        with self.obs.span("engine.jit_dispatch"), self._view_lock:
+            self.ratings = self._update(
+                self.ratings,
+                packed.winners,
+                packed.losers,
+                packed.valid.astype(self._dtype),
+                packed.perm,
+                packed.bounds,
+            )
+            self.matches_applied += packed.num_real
+        if self.obs.enabled:
+            for t in np.flatnonzero(packed.tenant_counts):
+                self.obs.counter(
+                    "arena_tenant_matches_total", tenant=str(int(t))
+                ).inc(int(packed.tenant_counts[t]))
+        return self.ratings
+
+    def _pack_tenant(self, w, l):
+        return pack_tenant_batch(
+            self.tenant_bucket, self.players_per_tenant, w, l,
+            self.min_bucket, np.float32,
+        )
+
+    def ingest(self, winners, losers, tenant=None):  # deterministic; mutates: _store, ratings, matches_applied
+        """`ArenaEngine.ingest` re-routed through the fused tenant
+        update: merge into the ONE composite store, pack tenant-major,
+        dispatch once for every tenant in the batch."""
+        self._drain_pipeline()
+        w, l = self._compose(winners, losers, tenant)
+        with self.obs.span("batch.ingest"):
+            self._store.add(w, l)
+            if w.shape[0] == 0:
+                return self.ratings
+            return self._apply_tenant(self._pack_tenant(w, l))
+
+    def update(self, winners, losers, tenant=None):  # deterministic; mutates: _store, ratings, matches_applied
+        """Alias of the fused path — a multi-tenant engine has exactly
+        one update route, so sync/async/replayed batches all hit the
+        same kernel (the replica bit-exactness contract)."""
+        return self.ingest(winners, losers, tenant=tenant)
+
+    def ingest_async(self, winners, losers, producer=None, tenant=None):
+        """Async ingest through the inherited pipeline; the packer
+        thread runs the tenant-major pack (`_pack_for_pipeline`
+        override) and the dispatch half applies the fused update."""
+        w, l = self._compose(winners, losers, tenant)
+        return super().ingest_async(w, l, producer=producer)
+
+    def _pack_for_pipeline(self, w, l):  # deterministic; mutates: _store
+        # No staging slots: the tenant-major pack allocates its own
+        # arrays (double-buffered 1-D staging doesn't fit a (T, B)
+        # layout; the fused dispatch amortizes far more than staging
+        # saves).
+        self._store.add(w, l)
+        if w.shape[0] == 0:
+            return None
+        return self._pack_tenant(w, l)
+
+    def _dispatch_packed(self, packed):
+        with self.obs.span("engine.apply"):
+            return self._apply_tenant(packed)
+
+    # --- restore / reads ---------------------------------------------
+
+    def adopt_state(self, ratings, store):  # deterministic; mutates: ratings, _store, matches_applied
+        r = np.asarray(ratings, np.float32).reshape(-1)
+        super().adopt_state(r, store)
+        with self._view_lock:
+            self.ratings = self.ratings.reshape(
+                self.tenant_bucket, self.players_per_tenant
+            )
+        return self.ratings
+
+    def leaderboard(self, top_k=None, tenant=None):
+        """(player_id, rating) best-first; `tenant=` scopes to one
+        tenant's local ids, None ranks the whole composite space (the
+        admin view; idle padding rows rank at the base rating)."""
+        self._drain_pipeline()
+        if tenant is None:
+            # The admin view ranks the flat composite space (idle
+            # padding rows sit at the base rating).
+            flat = np.asarray(self.ratings).reshape(-1)
+            order = np.argsort(-flat, kind="stable")
+            if top_k is not None:
+                order = order[:top_k]
+            return [(int(i), float(flat[i])) for i in order]
+        t = _validate_tenant(self.num_tenants, tenant)
+        row = np.asarray(self.ratings[t])
+        order = np.argsort(-row, kind="stable")
+        if top_k is not None:
+            order = order[:top_k]
+        return [(int(i), float(row[i])) for i in order]
+
+
+class CategoryRegistry:
+    """category name -> tenant slot: per-category leaderboards (the
+    LMSYS slice use-case) riding the multi-tenant key.
+
+    `resolve` is the wire sanitizer for the submit path's `category=`
+    field — an unknown category is a reject unless the registry was
+    built with ``auto_register=True`` AND the engine can grow. Slots
+    are assigned in registration order and never reused."""
+
+    def __init__(self, engine, categories=(), auto_register=False):
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._slots = {}
+        self.auto_register = auto_register
+        for name in categories:
+            self.register(name)
+
+    def register(self, category):
+        """Assign `category` the next tenant slot (idempotent)."""
+        if not isinstance(category, str) or not category:
+            raise ValueError(
+                f"category must be a non-empty string, got {category!r}"
+            )
+        with self._lock:
+            if category in self._slots:
+                return self._slots[category]
+            slot = len(self._slots)
+            self._engine.ensure_tenants(slot + 1)
+            self._slots[category] = slot
+            return slot
+
+    def resolve(self, category):
+        """Map a category onto its tenant slot; unknown categories are
+        a reject (or an auto-registration when configured)."""
+        with self._lock:
+            slot = self._slots.get(category)
+        if slot is not None:
+            return slot
+        if self.auto_register:
+            return self.register(category)
+        raise ValueError(
+            f"unknown category {category!r}: this arena serves "
+            f"{sorted(self._slots)}"
+        )
+
+    def categories(self):
+        """(category, tenant slot) pairs in slot order."""
+        with self._lock:
+            return sorted(self._slots.items(), key=lambda kv: kv[1])

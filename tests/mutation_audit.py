@@ -575,7 +575,7 @@ MUTATIONS = (
         "arena/analysis/absint.py",
         'TAINT_SANITIZER_TAILS = frozenset({\n'
         '    "parse_submit_body", "parse_path", "_query_int", "_validate_matches",\n'
-        '    "pack_batch", "pack_epoch",\n'
+        '    "_validate_tenant", "pack_batch", "pack_epoch",\n'
         '})',
         'TAINT_SANITIZER_TAILS = frozenset()',
         "the taint rule's whole meaning is 'sanitized on every path': with "
@@ -855,6 +855,42 @@ MUTATIONS = (
         "nobody pulls the burn rate — killed by "
         "test_replica_staleness_slo_and_profiler_roles (the engine's "
         "evaluations counter must advance while the reader tails)",
+    ),
+    (
+        "tenant-key-dropped-from-segment-sort",
+        "arena/tenancy.py",
+        "    return ids + np.int32(tenant * players_per_tenant)",
+        "    return ids + np.int32(0 * players_per_tenant)",
+        "the composite id IS the tenant key: drop the tenant offset and "
+        "every tenant's matches collapse into tenant 0's segment range, "
+        "so one shared kernel silently cross-pollinates leaderboards — "
+        "killed by test_store_groups_tenant_major (stored composite ids "
+        "must land in each submitting tenant's id range and idle "
+        "tenants' rating rows must stay untouched)",
+    ),
+    (
+        "tenant-bucket-never-padded",
+        "arena/tenancy.py",
+        "    return max(min_bucket, _pow2_ceil(max(int(num_tenants), 1)))",
+        "    return max(int(num_tenants), 1)",
+        "the pow2 tenant bucket is the zero-recompile contract: size "
+        "state to the exact tenant count and every onboarded tenant "
+        "changes the jitted ratings shape, retracing the kernel — "
+        "killed by test_tenant_growth_within_bucket_zero_recompiles "
+        "(growing 5 -> 8 tenants must keep the bucket and add zero "
+        "compiles)",
+    ),
+    (
+        "wire-tenant-validation-skipped",
+        "arena/engine.py",
+        "    if not 0 <= t < num_tenants:",
+        "    if False:",
+        "_validate_tenant is the wire sanitizer for the tenant key: "
+        "skip the range check and a submit to an out-of-range tenant "
+        "composites into some other tenant's (or nobody's) id space "
+        "instead of 400ing at the door — killed by "
+        "test_wire_unknown_tenant_rejected (tenant 5 and 99 on a "
+        "3-tenant arena must 400 on every endpoint and apply nothing)",
     ),
 )
 

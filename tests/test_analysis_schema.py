@@ -267,7 +267,7 @@ def test_version_bump_suppresses_schema_drift():
     src = SERVING.read_text().replace(
         '"bin_bytes": len(blob),',
         '"bin_bytes": len(blob),\n        "spare_field": 0,',
-    ).replace("SNAPSHOT_VERSION = 2", "SNAPSHOT_VERSION = 3")
+    ).replace("SNAPSHOT_VERSION = 3", "SNAPSHOT_VERSION = 4")
     assert _lint_serving(src) == []
 
 

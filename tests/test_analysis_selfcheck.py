@@ -190,8 +190,8 @@ def test_clean_pass_is_not_vacuous():
     # writers — the shapes the sidecar registry pins.
     schemas = {
         "arena/serving.py": {
-            "write_snapshot": ("arena-snapshot", 2),
-            "_validate_chain_link": ("incremental-manifest", 1),
+            "write_snapshot": ("arena-snapshot", 3),
+            "_validate_chain_link": ("incremental-manifest", 2),
             "ArenaServer._player_row": ("wire-player-row", 1),
         },
         "arena/net/protocol.py": {

@@ -609,6 +609,82 @@ def test_replica_bench_full_size_over_real_http():
     assert rep["catchup"]["catchup_s"] <= rep["catchup"]["catchup_bound_s"]
 
 
+TENANT_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "tenant",
+    "ARENA_BENCH_TENANTS": "6",
+    "ARENA_BENCH_TENANT_PLAYERS": "32",
+    "ARENA_BENCH_TENANT_ROUND": "32",
+    "ARENA_BENCH_TENANT_ROUNDS": "2",
+    # At toy sizes per-call overhead dominates both sides; the speedup
+    # FLOOR is a full-size property, so the smoke only checks the
+    # machinery (growth sentinel, bit-exactness, ops plane) end to end.
+    "ARENA_BENCH_TENANT_MIN_SPEEDUP": "0",
+}
+
+
+def test_tenant_bench_smoke_contract():
+    """ARENA_BENCH_MODE=tenant through the real entrypoint: one JSON
+    line, rc 0, the arena_tenant metric with 6 tenants fused through
+    one engine — tenants grown 5 -> 6 inside the pow2 bucket under the
+    recompile sentinel, every tenant bit-exact vs its own dedicated
+    engine (the permanently-empty last tenant included), and the
+    tenant-labeled counters reconciling on the one live registry."""
+    result = run_bench(TENANT_SMOKE_ENV, timeout=300)
+    assert result["metric"] == "arena_tenant"
+    assert result["unit"] == "x_vs_dedicated_engines"
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["value"] > 0
+    assert result["params"]["tenants"] == 6
+    assert result["params"]["tenant_bucket"] == 8
+    assert result["params"]["grow_from"] == 5
+    ten = result["tenant"]
+    assert ten["steady_state_new_compiles"] == 0
+    assert ten["bit_exact_tenants"] == 6
+    assert ten["zero_match_tenant"] == 5
+    # Every tenant that received matches is labeled on the ops plane.
+    assert ten["ops_plane_tenants_labeled"] == 5
+    assert ten["batched_s"] > 0 and ten["dedicated_s"] > 0
+    assert ten["timed_matches"] == 2 * 5 * 32  # rounds x active x round
+
+
+def test_tenant_bench_speedup_gate_is_hard(tmp_path):
+    """The fusion floor is a verdict, not a log line: an impossible
+    MIN_SPEEDUP turns the run into arena_bench_tenant_gate_failure at
+    rc 2 with a flight-recorder bundle — never an arena_tenant line."""
+    result = run_bench(
+        {
+            **TENANT_SMOKE_ENV,
+            "ARENA_BENCH_TENANT_MIN_SPEEDUP": "1e9",
+            "ARENA_DEBUG_DIR": str(tmp_path),
+        },
+        timeout=300,
+        expect_rc=2,
+    )
+    assert result["metric"] == "arena_bench_tenant_gate_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "x_vs_dedicated_engines"
+    assert "tenant" not in result
+    assert "dedicated loop" in result["error"]
+    bundle = pathlib.Path(result["debug_bundle"])
+    assert bundle.parent == tmp_path
+    assert (bundle / "metrics.json").exists()
+
+
+@pytest.mark.slow
+def test_tenant_bench_full_size_hits_5x():
+    """The acceptance run at the acceptance size: 256 tenants x 1k
+    players, batched >= 5x the dedicated-engine loop, bit-exact
+    per-tenant, zero recompiles across within-bucket growth."""
+    result = run_bench({"ARENA_BENCH_MODE": "tenant"}, timeout=600)
+    assert result["metric"] == "arena_tenant"
+    assert result["params"]["tenants"] == 256
+    assert result["value"] >= 5.0
+    assert result["equivalence_ok"] is True
+    assert result["tenant"]["steady_state_new_compiles"] == 0
+    assert result["tenant"]["bit_exact_tenants"] == 256
+
+
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     """The hard gate: with the tolerance forced to 0 the (real, tiny)
     float32-vs-float64 divergence trips it — one JSON line carrying the

@@ -223,7 +223,7 @@ def test_restore_rejects_mismatched_manifest_version(tmp_path):
     doc["version"] = 99
     man.write_text(json.dumps(doc))
     assert_reject_leaves_engine_untouched(
-        srv, snap, match=r"expected 2, found 99"
+        srv, snap, match=r"expected 3, found 99"
     )
 
 
@@ -233,7 +233,7 @@ def test_restore_rejects_corrupt_binary_header(tmp_path):
     blob[8:12] = (7).to_bytes(4, "little")  # header version field
     (snap / serving.ARRAYS_NAME).write_bytes(bytes(blob))
     assert_reject_leaves_engine_untouched(
-        srv, snap, match=r"header version: expected 2, found 7"
+        srv, snap, match=r"header version: expected 3, found 7"
     )
     # A payload byte flip past the header is caught by the checksum.
     blob = bytearray((snap / serving.ARRAYS_NAME).read_bytes())

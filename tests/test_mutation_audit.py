@@ -83,11 +83,15 @@ def test_mutations_cover_every_policed_surface():
     extractor, the version-bump comparison direction, the replication
     closure's fixpoint), and since PR 18 the replication layer (the
     replica's strict-sequence apply, the incremental snapshot chain's
-    base-identity link, the staleness objective's burn-rate pull)."""
+    base-identity link, the staleness objective's burn-rate pull), and
+    since PR 19 the multi-tenant plane (the composite-id tenant key,
+    the pow2 tenant bucket, the wire tenant sanitizer)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
         "verify_reference.py",
+        "arena/engine.py",
+        "arena/tenancy.py",
         "arena/analysis/jaxlint.py",
         "arena/analysis/project.py",
         "arena/analysis/absint.py",
@@ -133,6 +137,8 @@ def _fake_sources_only(dest):
     for name in (
         "bench.py",
         "verify_reference.py",
+        "arena/engine.py",
+        "arena/tenancy.py",
         "arena/analysis/jaxlint.py",
         "arena/analysis/project.py",
         "arena/analysis/absint.py",

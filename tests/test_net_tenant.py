@@ -1,0 +1,187 @@
+"""Tenant keys over the wire: `?tenant=` reads, tenant/category submit,
+byte cache keyed per tenant, `/log` tenant columns (arena/net/*).
+
+One real `ThreadingHTTPServer` over a `MultiTenantEngine`, same stack
+as test_net_wire.py. The named mutation-audit kill here is
+`test_wire_unknown_tenant_rejected`: `_validate_tenant` is the wire
+sanitizer that keeps an out-of-range tenant id from silently folding
+its matches into a neighboring tenant's leaderboard — skip the range
+check and the 400s below become 202s.
+"""
+
+import numpy as np
+import pytest
+
+from arena.net import ArenaHTTPServer, FrontDoor, WireClient
+from arena.obs import Observability
+from arena.serving import ArenaServer
+from arena.tenancy import CategoryRegistry, MultiTenantEngine
+
+P = 32
+TENANTS = 3
+
+
+@pytest.fixture(scope="module")
+def wire():
+    obs = Observability()
+    eng = MultiTenantEngine(
+        P, num_tenants=TENANTS, min_bucket=64, obs=obs
+    )
+    srv = ArenaServer(engine=eng, max_staleness_matches=0, obs=obs)
+    frontdoor = FrontDoor(eng, capacity=32, record_applied=True)
+    categories = CategoryRegistry(eng, categories=("chat", "code", "vision"))
+    server = ArenaHTTPServer(
+        srv, frontdoor=frontdoor, categories=categories
+    ).start()
+    client = WireClient(server.host, server.port)
+    yield server, client
+    client.close()
+    server.close()
+    frontdoor.close()
+    srv.close()
+
+
+def _settle(server):
+    server.frontdoor.flush()
+    server.server.refresh_view()
+
+
+def test_wire_unknown_tenant_rejected(wire):
+    """The named kill for wire-tenant-validation-skipped: every wire
+    entry point — submit, the read endpoints, batched /query — rejects
+    a tenant id outside [0, num_tenants) with a 400 naming the range,
+    and rejects non-integer tenants outright."""
+    server, client = wire
+    applied_before = server.server.engine.matches_applied
+    # Submit: in-bucket-but-inactive (5) and out-of-bucket (99) both 400.
+    for bad in (5, 99, -1):
+        status, resp = client.submit([1], [2], tenant=bad)
+        assert status == 400, (bad, resp)
+        assert "unknown tenant" in resp["error"]
+    # Reads: same reject, same sanitizer.
+    for path in (
+        "/leaderboard?limit=3&tenant=5",
+        "/player/1?tenant=99",
+        "/h2h?a=1&b=2&tenant=-1",
+    ):
+        status, resp = client.get(path)
+        assert status == 400, (path, resp)
+        assert "unknown tenant" in resp["error"]
+    status, resp = client.get("/leaderboard?limit=3&tenant=x")
+    assert status == 400
+    status, resp = client.batch_query([{"players": [1], "tenant": 5}])
+    assert status == 400 and "unknown tenant" in resp["error"]
+    server.frontdoor.flush()
+    assert server.server.engine.matches_applied == applied_before
+
+
+def test_submit_by_tenant_and_category_scope_ratings(wire):
+    server, client = wire
+    eng = server.server.engine
+    status, resp = client.submit([3, 4], [5, 6], tenant=1)
+    assert status == 202 and resp["tenant"] == 1
+    status, resp = client.submit([7], [8], category="vision")
+    assert status == 202 and resp["tenant"] == 2
+    status, resp = client.submit(
+        [1], [2], tenant=0, category="chat"
+    )
+    assert status == 400  # one or the other, never both
+    status, resp = client.submit([1], [2], category="nope")
+    assert status == 400 and "unknown category" in resp["error"]
+    _settle(server)
+    ratings = np.asarray(eng.ratings)
+    assert ratings[1][3] > 1500.0 and ratings[1][5] < 1500.0
+    assert ratings[2][7] > 1500.0
+    # Tenant-local ids never leak across slots.
+    assert ratings[0][3] == 1500.0
+
+
+def test_tenant_reads_slice_one_view(wire):
+    server, client = wire
+    _settle(server)
+    _status, lb1 = client.get("/leaderboard?limit=5&tenant=1")
+    assert lb1["tenant"] == 1
+    assert lb1["leaderboard"][0]["player"] in (3, 4)
+    assert all(0 <= r["player"] < P for r in lb1["leaderboard"])
+    _status, player = client.get("/player/7?tenant=2")
+    assert player["tenant"] == 2
+    assert player["players"][0]["player"] == 7
+    assert player["players"][0]["rating"] > 1500.0
+    _status, h2h = client.get("/h2h?a=7&b=8&tenant=2")
+    assert h2h["pairs"][0]["p_a_beats_b"] > 0.5
+    # No tenant param -> composite admin view, no tenant key.
+    _status, admin = client.get("/leaderboard?limit=3")
+    assert "tenant" not in admin
+    # Batched specs mix tenants against ONE view.
+    _status, out = client.batch_query([
+        {"players": [3], "tenant": 1},
+        {"players": [3], "tenant": 0},
+        {"leaderboard": [0, 2]},
+    ])
+    rs = out["results"]
+    assert rs[0]["tenant"] == 1 and rs[0]["players"][0]["rating"] > 1500.0
+    assert rs[1]["tenant"] == 0 and rs[1]["players"][0]["rating"] == 1500.0
+    assert "tenant" not in rs[2]
+    assert rs[0]["view_seq"] == rs[1]["view_seq"] == rs[2]["view_seq"]
+
+
+def test_byte_cache_keys_on_tenant(wire):
+    """The watermark-keyed byte cache must key on tenant: two tenants'
+    identical-shaped leaderboard reads are DIFFERENT cache entries, and
+    a repeat read hits without cross-tenant bleed."""
+    server, client = wire
+    srv = server.server
+    _settle(server)
+    hits_before = srv.obs.registry.counter_sum("arena_wire_cache_hits_total")
+    _status, first1 = client.get("/leaderboard?offset=0&limit=4&tenant=1")
+    _status, first0 = client.get("/leaderboard?offset=0&limit=4&tenant=0")
+    _status, again1 = client.get("/leaderboard?offset=0&limit=4&tenant=1")
+    _status, again0 = client.get("/leaderboard?offset=0&limit=4&tenant=0")
+    hits_after = srv.obs.registry.counter_sum("arena_wire_cache_hits_total")
+    assert hits_after >= hits_before + 2
+
+    def rows(resp):
+        return [(r["player"], r["rating"]) for r in resp["leaderboard"]]
+
+    assert rows(again1) == rows(first1)
+    assert rows(again0) == rows(first0)
+    assert rows(first1) != rows(first0)  # tenant 1 has winners, 0 is idle
+
+
+def test_log_records_carry_tenant_column(wire):
+    server, client = wire
+    server.frontdoor.flush()
+    _status, log = client.get("/log?after_seq=-1")
+    assert log["records"], "submits above must be in the log"
+    for rec in log["records"]:
+        assert "tenant" in rec
+    tenants = {rec["tenant"] for rec in log["records"]}
+    assert {1, 2} <= tenants  # the tenant= and category= submits above
+    # Replay stays composite: record ids are composite-space ints.
+    rec = next(r for r in log["records"] if r["tenant"] == 1)
+    assert all(P <= i < 2 * P for i in rec["winners"] + rec["losers"])
+
+
+def test_as_of_and_tenant_do_not_combine(wire):
+    _server, client = wire
+    status, resp = client.get("/leaderboard?limit=3&tenant=1&as_of=0")
+    assert status == 400
+    assert "cannot be combined" in resp["error"]
+
+
+def test_category_submit_requires_registry():
+    obs = Observability()
+    eng = MultiTenantEngine(P, num_tenants=1, min_bucket=64, obs=obs)
+    srv = ArenaServer(engine=eng, obs=obs)
+    frontdoor = FrontDoor(eng, record_applied=True)
+    server = ArenaHTTPServer(srv, frontdoor=frontdoor).start()
+    client = WireClient(server.host, server.port)
+    try:
+        status, resp = client.submit([1], [2], category="chat")
+        assert status == 400
+        assert "no category registry" in resp["error"]
+    finally:
+        client.close()
+        server.close()
+        frontdoor.close()
+        srv.close()

@@ -233,12 +233,15 @@ def test_parse_path_routes_and_statuses():
 
 
 def test_parse_submit_body_validates_shape():
-    w, l, producer = parse_submit_body(
+    w, l, producer, tenant, category = parse_submit_body(
         b'{"winners": [1, 2], "losers": [3, 4], "producer": "p1"}'
     )
     assert w.dtype == np.int32 and list(w) == [1, 2] and list(l) == [3, 4]
     assert producer == "p1"
-    _w, _l, producer = parse_submit_body(b'{"winners": [], "losers": []}')
+    assert tenant is None and category is None
+    _w, _l, producer, _t, _c = parse_submit_body(
+        b'{"winners": [], "losers": []}'
+    )
     assert producer == "local"
     for raw in [
         b"not json",
@@ -251,6 +254,28 @@ def test_parse_submit_body_validates_shape():
         with pytest.raises(ProtocolError) as exc:
             parse_submit_body(raw)
         assert exc.value.status == 400
+
+
+def test_parse_submit_body_tenant_and_category():
+    _w, _l, _p, tenant, category = parse_submit_body(
+        b'{"winners": [1], "losers": [2], "tenant": 3}'
+    )
+    assert tenant == 3 and category is None
+    _w, _l, _p, tenant, category = parse_submit_body(
+        b'{"winners": [1], "losers": [2], "category": "vision"}'
+    )
+    assert tenant is None and category == "vision"
+    for raw in [
+        b'{"winners": [1], "losers": [2], "tenant": "x"}',
+        b'{"winners": [1], "losers": [2], "tenant": 1.5}',
+        b'{"winners": [1], "losers": [2], "tenant": true}',
+        b'{"winners": [1], "losers": [2], "category": ""}',
+        b'{"winners": [1], "losers": [2], "category": 7}',
+        b'{"winners": [1], "losers": [2], "tenant": 0, "category": "a"}',
+    ]:
+        with pytest.raises(ProtocolError) as exc:
+            parse_submit_body(raw)
+        assert exc.value.status == 400, raw
 
 
 def test_make_response_is_the_authoritative_envelope():
@@ -429,7 +454,7 @@ def test_every_endpoint_matches_its_golden_key_set(wire):
     _status, log_page = client.get("/log?after_seq=-1&limit=1")
     for rec in log_page["records"]:
         assert set(rec) == {"seq", "kind", "winners", "losers",
-                            "record_watermark"}
+                            "record_watermark", "tenant"}
 
 
 def test_as_of_responses_match_the_golden_query_shape(wire, tmp_path):

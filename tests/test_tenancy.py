@@ -1,0 +1,359 @@
+"""Multi-tenant arena contracts (arena/tenancy.py).
+
+The load-bearing claim is BIT-exactness: every tenant's ratings row out
+of the fused ``(tenant_bucket, players)`` update must equal — by
+`np.array_equal`, not a tolerance — a dedicated single-tenant
+`ArenaEngine` fed the same per-round batches with the same row bucket.
+The property test here drives that across random tenant splits, three
+seeds, a permanently empty tenant, and a tenant-bucket boundary
+crossing mid-stream.
+
+Two mutation-audit kills are named here:
+- `test_store_groups_tenant_major` kills tenant-key-dropped-from-
+  segment-sort (compose_ids without the tenant offset collapses every
+  tenant onto tenant 0's id range).
+- `test_tenant_growth_within_bucket_zero_recompiles` kills
+  tenant-bucket-never-padded (an unpadded tenant axis recompiles on
+  every tenant added — the sentinel turns red).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from arena import engine, serving, tenancy
+from arena.analysis import sanitize
+from arena.engine import ArenaEngine, _validate_tenant
+from arena.obs import Observability
+from arena.tenancy import (
+    CategoryRegistry,
+    MIN_TENANT_BUCKET,
+    MultiTenantEngine,
+    compose_ids,
+    pack_tenant_batch,
+    tenant_bucket,
+)
+
+P = 16  # players per tenant, small: compiles stay cheap
+ROW_BUCKET = 16  # min_bucket both sides — the bit-exactness precondition
+
+
+def _matches(n, players, rng):
+    w = rng.integers(0, players, n).astype(np.int32)
+    l = ((w + 1 + rng.integers(0, players - 1, n)) % players).astype(np.int32)
+    return w, l
+
+
+# --- geometry ---------------------------------------------------------------
+
+
+def test_tenant_bucket_is_pow2_with_floor():
+    assert tenant_bucket(1) == MIN_TENANT_BUCKET
+    assert tenant_bucket(MIN_TENANT_BUCKET) == MIN_TENANT_BUCKET
+    assert tenant_bucket(MIN_TENANT_BUCKET + 1) == 2 * MIN_TENANT_BUCKET
+    assert tenant_bucket(3, min_bucket=4) == 4
+    assert tenant_bucket(5, min_bucket=4) == 8
+    assert tenant_bucket(200) == 256
+
+
+def test_compose_ids_is_tenant_major():
+    ids = np.array([0, 3, 15], np.int32)
+    out = compose_ids(ids, 2, P)
+    assert list(out) == [32, 35, 47]
+    assert out.dtype == np.int32
+    # Tenant-major: every tenant-2 composite sorts after every tenant-1.
+    assert compose_ids(np.int32(P - 1), 1, P) < compose_ids(np.int32(0), 2, P)
+
+
+def test_pack_tenant_batch_rejects_cross_tenant():
+    w = compose_ids(np.array([1], np.int32), 0, P)
+    l = compose_ids(np.array([2], np.int32), 1, P)
+    with pytest.raises(ValueError, match="cross-tenant"):
+        pack_tenant_batch(4, P, w, l, min_bucket=ROW_BUCKET)
+
+
+def test_validate_tenant_rejects_garbage():
+    assert _validate_tenant(4, 3) == 3
+    assert _validate_tenant(4, np.int64(0)) == 0
+    for bad in (-1, 4, 99):
+        with pytest.raises(ValueError, match="unknown tenant"):
+            _validate_tenant(4, bad)
+    for bad in ("x", 1.5, None, True):
+        with pytest.raises(ValueError, match="tenant must be an integer"):
+            _validate_tenant(4, bad)
+
+
+# --- the bit-exactness property ---------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_batched_bit_exact_vs_dedicated_engines(seed):
+    """Random matches split across T tenants through ONE fused engine
+    land bit-identically on T dedicated engines fed the same per-round
+    batches — including a tenant that never gets a match and a tenant-
+    bucket boundary crossing mid-stream (both sides keep the same row
+    bucket, the documented precondition)."""
+    rng = np.random.default_rng(seed)
+    eng = MultiTenantEngine(
+        P, num_tenants=3, min_bucket=ROW_BUCKET, min_tenant_bucket=4
+    )
+    assert eng.tenant_bucket == 4
+    dedicated = {}
+
+    def dedicated_for(t):
+        if t not in dedicated:
+            dedicated[t] = ArenaEngine(P, min_bucket=ROW_BUCKET)
+        return dedicated[t]
+
+    def play_round(active):
+        ws, ls = [], []
+        for t in range(active):
+            if t in (2, 5):
+                continue  # the permanently empty tenants
+            n = int(rng.integers(0, ROW_BUCKET + 1))  # 0..row bucket
+            if n == 0:
+                continue
+            w, l = _matches(n, P, rng)
+            dedicated_for(t).ingest(w, l)
+            ws.append(compose_ids(w, t, P))
+            ls.append(compose_ids(l, t, P))
+        if ws:
+            eng.ingest(np.concatenate(ws), np.concatenate(ls))
+
+    for _ in range(3):
+        play_round(3)
+    before_growth = np.asarray(eng.ratings).copy()
+    eng.ensure_tenants(6)  # bucket 4 -> 8: the boundary crossing
+    assert eng.tenant_bucket == 8
+    assert eng.num_players == 8 * P
+    # Crossing pads with base rows and bit-preserves existing tenants.
+    assert np.array_equal(np.asarray(eng.ratings)[:4], before_growth)
+    for _ in range(3):
+        play_round(6)
+
+    got = np.asarray(eng.ratings)
+    assert got.dtype == np.float32
+    base = np.full(P, engine.R.DEFAULT_BASE, np.float32)
+    for t in range(6):
+        want = (
+            np.asarray(dedicated[t].ratings) if t in dedicated else base
+        )
+        assert np.array_equal(got[t], want), f"tenant {t} diverged (seed {seed})"
+    # The empty tenants stayed bit-identical to base (the +-0.0 property).
+    assert np.array_equal(got[2], base)
+    assert np.array_equal(got[5], base)
+
+
+def test_async_ingest_bit_exact_to_sync():
+    rng = np.random.default_rng(7)
+    sync_eng = MultiTenantEngine(P, num_tenants=3, min_bucket=ROW_BUCKET)
+    async_eng = MultiTenantEngine(P, num_tenants=3, min_bucket=ROW_BUCKET)
+    async_eng.start_pipeline()
+    for _ in range(4):
+        for t in range(3):
+            w, l = _matches(8, P, rng)
+            sync_eng.ingest(w, l, tenant=t)
+            async_eng.ingest_async(w, l, tenant=t)
+    async_eng.flush()
+    assert np.array_equal(
+        np.asarray(sync_eng.ratings), np.asarray(async_eng.ratings)
+    )
+    assert async_eng.matches_applied == sync_eng.matches_applied
+
+
+# --- the mutation-audit kills -----------------------------------------------
+
+
+def test_store_groups_tenant_major():
+    """Named kill for tenant-key-dropped-from-segment-sort: the store
+    must hold COMPOSITE ids (tenant the leading sort key), so each
+    tenant's matches live in its own id range and its ratings row moves
+    alone. Drop the tenant term from `compose_ids` and every tenant
+    collapses onto tenant 0's range — both assertions go red."""
+    eng = MultiTenantEngine(P, num_tenants=4, min_bucket=ROW_BUCKET)
+    rng = np.random.default_rng(3)
+    w0, l0 = _matches(8, P, rng)
+    w2, l2 = _matches(8, P, rng)
+    eng.ingest(w0, l0, tenant=0)
+    eng.ingest(w2, l2, tenant=2)
+    state = eng._store.export_state()
+    stored_w = np.asarray(state["winners"])
+    stored_tenants = np.sort(np.unique(stored_w // P))
+    assert list(stored_tenants) == [0, 2], (
+        f"store holds tenant ranges {stored_tenants}, expected [0, 2] — "
+        "composite ids must carry the tenant offset"
+    )
+    ratings = np.asarray(eng.ratings)
+    base = np.full(P, engine.R.DEFAULT_BASE, np.float32)
+    assert not np.array_equal(ratings[0], base)
+    assert not np.array_equal(ratings[2], base)
+    assert np.array_equal(ratings[1], base)
+    assert np.array_equal(ratings[3], base)
+    # And the BT refit consumes the same composite grouping: strengths
+    # come back over the whole composite space.
+    strengths = eng.bt_strengths(num_iters=3)
+    assert np.asarray(strengths).shape == (eng.num_players,)
+
+
+def test_tenant_growth_within_bucket_zero_recompiles():
+    """Named kill for tenant-bucket-never-padded: adding tenants inside
+    one pow2 tenant bucket is bookkeeping — no shape change, no new jit
+    compiles. Without the pow2 pad, every added tenant changes the
+    (tenant, players) dispatch shape and the sentinel turns red."""
+    eng = MultiTenantEngine(P, num_tenants=5, min_bucket=ROW_BUCKET)
+    assert eng.tenant_bucket == MIN_TENANT_BUCKET  # 5 padded up to 8
+    rng = np.random.default_rng(11)
+
+    def round_for(active):
+        for t in range(active):
+            w, l = _matches(8, P, rng)
+            eng.ingest(w, l, tenant=t)
+
+    round_for(5)  # warmup: compiles the (bucket, P) fused update once
+    jax.block_until_ready(eng.ratings)
+    sentinel = sanitize.RecompileSentinel(update=eng.num_compiles)
+    for want in (6, 7, 8):
+        assert eng.ensure_tenants(want) == want
+        round_for(want)
+    jax.block_until_ready(eng.ratings)
+    sentinel.assert_no_new_compiles()
+    assert eng.tenant_bucket == MIN_TENANT_BUCKET
+    assert eng.num_players == MIN_TENANT_BUCKET * P
+
+
+# --- reads / registry -------------------------------------------------------
+
+
+def test_tenant_leaderboard_is_local_ids():
+    eng = MultiTenantEngine(P, num_tenants=3, min_bucket=ROW_BUCKET)
+    eng.ingest([1], [2], tenant=1)
+    board = eng.leaderboard(top_k=3, tenant=1)
+    assert board[0][0] == 1 and board[0][1] > engine.R.DEFAULT_BASE
+    assert all(0 <= p < P for p, _r in board)
+    with pytest.raises(ValueError, match="unknown tenant"):
+        eng.leaderboard(tenant=7)
+    # The admin view ranks the whole composite space.
+    admin = eng.leaderboard(top_k=1)
+    assert admin[0][0] == compose_ids(np.int32(1), 1, P)
+
+
+def test_category_registry_maps_names_to_slots():
+    eng = MultiTenantEngine(P, num_tenants=1, min_bucket=ROW_BUCKET)
+    reg = CategoryRegistry(eng, categories=("chat", "code"))
+    assert reg.resolve("chat") == 0
+    assert reg.resolve("code") == 1
+    assert reg.register("chat") == 0  # idempotent
+    assert eng.num_tenants >= 2  # registration grew the roster
+    with pytest.raises(ValueError, match="unknown category 'vision'"):
+        reg.resolve("vision")
+    with pytest.raises(ValueError, match="non-empty string"):
+        reg.register("")
+    assert reg.categories() == [("chat", 0), ("code", 1)]
+    auto = CategoryRegistry(eng, auto_register=True)
+    slot = auto.resolve("fresh")
+    assert auto.resolve("fresh") == slot
+
+
+# --- snapshots (arena-snapshot@v3) ------------------------------------------
+
+
+def test_snapshot_v3_roundtrip_rebuilds_multitenant(tmp_path):
+    eng = MultiTenantEngine(P, num_tenants=3, min_bucket=ROW_BUCKET)
+    srv = serving.ArenaServer(engine=eng, obs=Observability())
+    rng = np.random.default_rng(5)
+    for t in (0, 2):
+        w, l = _matches(8, P, rng)
+        eng.ingest(w, l, tenant=t)
+    snap = tmp_path / "snap"
+    manifest = srv.snapshot(snap)
+    assert manifest["version"] == serving.SNAPSHOT_VERSION == 3
+    assert manifest["num_tenants"] == 3
+    assert manifest["players_per_tenant"] == P
+    assert manifest["num_players"] == eng.num_players  # composite bound
+    _m, arrays = serving.read_snapshot(snap)
+    counts = arrays["tenant_counts"]
+    assert counts.dtype == np.int32 and counts.shape == (3,)
+    assert list(counts) == [8, 0, 8]
+
+    srv2 = serving.ArenaServer(num_players=2)
+    srv2.restore(snap)
+    eng2 = srv2.engine
+    assert isinstance(eng2, MultiTenantEngine)
+    assert eng2.players_per_tenant == P
+    assert eng2.num_tenants == 3
+    assert eng2.tenant_bucket == eng.tenant_bucket
+    assert np.array_equal(np.asarray(eng2.ratings), np.asarray(eng.ratings))
+    # Tenant reads answer from the restored slices.
+    out = srv2.query(leaderboard=(0, 3), tenant=0)
+    assert out["tenant"] == 0
+    assert all(0 <= row["player"] < P for row in out["leaderboard"])
+    srv.close()
+    srv2.close()
+
+
+def test_snapshot_single_tenant_defaults_restore_plain_engine(tmp_path):
+    srv = serving.ArenaServer(num_players=P)
+    srv.engine.ingest([1, 2], [3, 4])
+    snap = tmp_path / "snap"
+    manifest = srv.snapshot(snap)
+    assert manifest["num_tenants"] == 1
+    assert manifest["players_per_tenant"] == P
+    srv2 = serving.ArenaServer(num_players=P)
+    srv2.restore(snap)
+    assert type(srv2.engine) is ArenaEngine  # no tenancy layer imposed
+    assert np.array_equal(
+        np.asarray(srv2.engine.ratings), np.asarray(srv.engine.ratings)
+    )
+    srv.close()
+    srv2.close()
+
+
+def test_incremental_chain_allows_tenant_growth(tmp_path):
+    """A base snapshot at 3 tenants chains with an increment cut after
+    within-bucket growth to 5 — tenants never shrink, and the restored
+    engine carries the grown roster."""
+    eng = MultiTenantEngine(P, num_tenants=3, min_bucket=ROW_BUCKET)
+    srv = serving.ArenaServer(engine=eng, obs=Observability())
+    rng = np.random.default_rng(9)
+    w, l = _matches(8, P, rng)
+    eng.ingest(w, l, tenant=1)
+    base_dir = tmp_path / "base"
+    srv.snapshot(base_dir)
+    eng.ensure_tenants(5)
+    w, l = _matches(8, P, rng)
+    eng.ingest(w, l, tenant=4)
+    inc_dir = tmp_path / "inc"
+    inc_manifest = srv.snapshot(inc_dir, base=base_dir)
+    assert inc_manifest["num_tenants"] == 5
+    srv2 = serving.ArenaServer(num_players=2)
+    srv2.restore(inc_dir)
+    assert srv2.engine.num_tenants == 5
+    assert np.array_equal(
+        np.asarray(srv2.engine.ratings), np.asarray(eng.ratings)
+    )
+    srv.close()
+    srv2.close()
+
+
+def test_query_parts_tenant_slices_one_view():
+    eng = MultiTenantEngine(P, num_tenants=3, min_bucket=ROW_BUCKET)
+    srv = serving.ArenaServer(engine=eng, obs=Observability())
+    eng.ingest([1], [2], tenant=1)
+    srv.refresh_view()
+    out = srv.query(leaderboard=(0, 2), players=[1], pairs=[(1, 2)], tenant=1)
+    assert out["tenant"] == 1
+    assert out["leaderboard"][0]["player"] == 1
+    assert out["players"][0]["rating"] > engine.R.DEFAULT_BASE
+    assert out["pairs"][0]["p_a_beats_b"] > 0.5
+    # Tenant 0 saw nothing: same view, different slice.
+    quiet = srv.query(players=[1], tenant=0)
+    assert quiet["players"][0]["rating"] == engine.R.DEFAULT_BASE
+    with pytest.raises(ValueError, match="unknown tenant"):
+        srv.query(leaderboard=(0, 2), tenant=9)
+    batch = srv.query_batch([
+        {"players": [1], "tenant": 1},
+        {"players": [1]},
+    ])
+    assert batch["results"][0]["tenant"] == 1
+    assert "tenant" not in batch["results"][1]
+    srv.close()

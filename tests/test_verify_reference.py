@@ -1501,3 +1501,32 @@ def test_manifest_write_failure_with_failed_cleanup_still_degrades(
     assert rc == verify_reference.EXIT_DRIFT
     assert result["manifest"] is None
     assert result["manifest_error"] == "OSError: read-only file system"
+
+
+# --- fingerprint stability: the pins match the LIVE repo -------------------
+
+
+def test_live_sidecars_match_pinned_fingerprint():
+    """The drift saga (rounds 4 and 5 re-pins) is settled: every
+    sidecar hash pinned in reference_fingerprint.json must equal a
+    fresh hash of the live file, so any future edit to BASELINE.json,
+    PAPERS.md or SNIPPETS.md shows up HERE — in tier-1 — instead of as
+    a surprise EXIT_DRIFT from the driver's next verify round. Note
+    BENCH_BASELINE.json is deliberately NOT pinned: perf baselines
+    (e.g. the arena_tenant pin) may move without re-surveying the
+    reference."""
+    repo = pathlib.Path(verify_reference.__file__).resolve().parent
+    pins = json.loads((repo / verify_reference.FINGERPRINT_NAME).read_text())
+    for key, relpath in verify_reference.SIDECAR_FILES.items():
+        observed, detail = verify_reference.observe_sidecar(repo / relpath)
+        assert observed not in (
+            verify_reference.SIDECAR_UNREADABLE,
+            verify_reference.SIDECAR_NOT_A_FILE,
+        ), (relpath, detail)
+        assert observed == pins[key], (
+            f"{relpath} drifted from its reference_fingerprint.json pin: "
+            f"re-pin deliberately (see NON_GRAFTABLE.md) or revert the edit"
+        )
+    assert "BENCH_BASELINE.json" not in set(
+        verify_reference.SIDECAR_FILES.values()
+    )
