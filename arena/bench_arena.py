@@ -172,8 +172,38 @@ reconcile exactly with the matches each tenant submitted — one ops
 plane, tenant-labeled, not N. The headline ``value`` is the
 batched-vs-dedicated speedup.
 
+A ninth mode, ``ARENA_BENCH_MODE=matchloop``, is the MATCHMAKING
+PLANE's acceptance harness (`arena/match/`): a deterministic
+closed-loop self-play soak. Three arms (active, random, and an active
+replay) each stand up a full server — `ArenaServer` + `FrontDoor` +
+`Matchmaker` + `ArenaHTTPServer` — and loop proposed matches back
+through real localhost HTTP: ``GET /match`` proposes pairings, a
+seeded ground-truth skill vector (a TIERED ladder — four hard tiers
+two logits apart with a narrow within-tier spread, the regime where
+match allocation actually matters: cross-tier matches are nearly
+foregone conclusions, so a policy that keeps spending there converges
+slowly) simulates the outcomes, and ``POST /submit`` feeds them back,
+with periodic `refresh_intervals()` so the active policy has live CI
+widths to chase. Each arm tracks the Spearman rank correlation
+between served ratings and ground truth and records how many matches
+it took to cross ``ARENA_BENCH_MATCHLOOP_CORR`` SUSTAINED for
+``ARENA_BENCH_MATCHLOOP_SUSTAIN`` consecutive checks (a single lucky
+check is not convergence under Elo's random-walk noise; the recorded
+count is the first check of the sustained streak). Four HARD gates (rc 2 + flight
+bundle): the convergence gate requires active sampling to reach the
+threshold at least ``ARENA_BENCH_MATCHLOOP_MIN_ADVANTAGE`` (1.1x)
+fewer matches than random pairing at equal budget; the
+seed-reproducibility gate requires the replay arm bit-equal to the
+first active arm (`np.array_equal` ratings AND the same
+matches-to-threshold); a `RecompileSentinel` over the update,
+bootstrap, and pair-scoring kernels requires zero steady-state
+compiles; and the SLO-silence gate requires zero alerts fired
+(`match-proposal-latency` included) across every arm. The headline
+``value`` is the convergence advantage: random's matches-to-threshold
+over active's.
+
 Env knobs (all optional): ARENA_BENCH_MODE (elo | ingest | pipeline |
-serve | soak | frontend | replica | tenant),
+serve | soak | frontend | replica | tenant | matchloop),
 ARENA_BENCH_MATCHES (100000), ARENA_BENCH_PLAYERS (1000),
 ARENA_BENCH_BATCH (8192), ARENA_BENCH_REPEATS (5), ARENA_BENCH_SEED
 (0), ARENA_BENCH_BT_ITERS (25), ARENA_BENCH_TOL (0.5 rating points —
@@ -203,7 +233,17 @@ ARENA_BENCH_TENANT_PLAYERS (1000, players per tenant),
 ARENA_BENCH_TENANT_ROUND (256, matches per tenant per round),
 ARENA_BENCH_TENANT_ROUNDS (4, timed rounds),
 ARENA_BENCH_TENANT_MIN_SPEEDUP (5.0, the batched-vs-dedicated floor),
-ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
+ARENA_BENCH_MATCHLOOP_PLAYERS (64, matchloop mode),
+ARENA_BENCH_MATCHLOOP_PROPOSALS (16, pairings per /match request),
+ARENA_BENCH_MATCHLOOP_BUDGET (20000, the per-arm match budget cap),
+ARENA_BENCH_MATCHLOOP_CORR (0.95, the Spearman rank-correlation
+threshold each arm races to), ARENA_BENCH_MATCHLOOP_SUSTAIN (6,
+consecutive at-or-above-threshold checks that count as convergence),
+ARENA_BENCH_MATCHLOOP_REFRESH_EVERY (8,
+iterations between bootstrap-interval refreshes),
+ARENA_BENCH_MATCHLOOP_MIN_ADVANTAGE (1.1, the active-vs-random
+convergence floor), ARENA_BENCH_MATCHLOOP_SLO_S (0.25, the
+match-proposal-latency SLO threshold), ARENA_BENCH_DEVICES (unset — forces a host CPU device count for the
 sharded path when the backend is not yet initialized),
 ARENA_BENCH_HISTORY (unset — append every emitted JSON line to this
 JSON Lines file, the input of the `python -m arena.obs.regress`
@@ -378,6 +418,15 @@ class TenantGateError(AssertionError):
     tenant's ratings diverged bitwise from its dedicated reference,
     within-bucket tenant growth recompiled, or the tenant-labeled ops
     plane failed to reconcile with the per-tenant match counts."""
+
+
+class MatchloopGateError(AssertionError):
+    """A matchloop hard gate failed: active sampling did not beat
+    random pairing to the ground-truth rank-correlation threshold at
+    equal match budget, two identical closed-loop runs diverged (the
+    seed-reproducibility contract), a steady-state proposal/update/
+    bootstrap shape recompiled, or an SLO objective fired during the
+    soak."""
 
 
 def _env_int(name, default):
@@ -2475,6 +2524,294 @@ def run_tenant_benchmark():
     }
 
 
+def _spearman(x, y):
+    """Spearman rank correlation between two score vectors (ranks by
+    stable descending argsort — the leaderboard's own tie discipline)."""
+    rx = np.empty(x.size)
+    rx[np.argsort(-x, kind="stable")] = np.arange(x.size)
+    ry = np.empty(y.size)
+    ry[np.argsort(-y, kind="stable")] = np.arange(y.size)
+    rx -= rx.mean()
+    ry -= ry.mean()
+    denom = float(np.sqrt((rx * rx).sum() * (ry * ry).sum()))
+    return float((rx * ry).sum() / denom) if denom else 0.0
+
+
+def _matchloop_ladder(players):
+    """Tiered ground-truth skills: four hard tiers three logits apart,
+    each with a narrow (±0.15) within-tier spread. This is the regime
+    where match ALLOCATION matters: a cross-tier match is a ~95%+
+    foregone conclusion that barely moves the ranking, so a policy
+    that keeps spending budget there (random pairing does, ~75% of
+    draws) converges slowly, while one that concentrates on
+    still-overlapping intervals resolves the within-tier order with
+    the same spend. A flat `np.linspace` ladder has no such structure
+    — every neighbour gap is equally hard — and the two policies race
+    inside Elo's K-factor noise floor there."""
+    tiers = min(4, players)
+    gap = 3.0
+    strength = np.empty(players)
+    bounds = np.linspace(0, players, tiers + 1).astype(int)
+    top = gap * (tiers - 1) / 2.0
+    for t in range(tiers):
+        lo, hi = bounds[t], bounds[t + 1]
+        if hi > lo:
+            strength[lo:hi] = (top - gap * t) + np.linspace(
+                0.15, -0.15, hi - lo
+            )
+    return strength
+
+
+def _run_matchloop_arm(policy, players, n_per_request, budget,
+                       corr_threshold, sustain, refresh_every,
+                       bootstrap_rounds, seed, slo_threshold_s):
+    """One closed-loop arm: a full server stack whose matches all come
+    from its own matchmaker over real localhost HTTP. Ground truth is
+    the tiered `_matchloop_ladder`; outcomes are Bernoulli draws from
+    the Bradley-Terry win prob under an RNG seeded by (seed, policy) —
+    so two arms with the same policy and seed replay bit-identically
+    end to end. Convergence is a SUSTAINED crossing: `sustain`
+    consecutive post-iteration correlation checks at or above the
+    threshold, recorded as the submitted count at the streak's first
+    check; the arm stops there (or at the budget cap). Returns the
+    arm's convergence record plus the final ratings vector for the
+    reproducibility gate."""
+    from arena import match as match_mod
+
+    strength = _matchloop_ladder(players)
+    rng = np.random.default_rng([seed, match_mod.POLICIES.index(policy)])
+    obs_live = obs_pkg.Observability(trace_capacity=8192)
+    _register_active_obs(obs_live)
+    obs_live.enable_ops(interval_s=1.0, intervals=60)
+    # Ownership transfer the analyzer cannot see: wire.close() below
+    # stops the ops plane; on a gate failure the one-shot process exits
+    # and the daemon ops threads die with it.
+    obs_live.start_ops()  # jaxlint: disable=resource-leaked-on-exception
+    srv = serving.ArenaServer(
+        num_players=players,
+        max_staleness_matches=0,
+        bootstrap_rounds=bootstrap_rounds,
+        obs=obs_live,
+    )
+    eng = srv.engine
+    frontdoor = net.FrontDoor(
+        eng, capacity=64, max_staleness_matches=2 * budget
+    )
+    matchmaker = match_mod.Matchmaker(srv, slo_threshold_s=slo_threshold_s)
+    wire = net.ArenaHTTPServer(
+        srv, frontdoor=frontdoor, matchmaker=matchmaker
+    ).start()
+    client = net.WireClient(wire.host, wire.port)
+    # Pin the bootstrap epoch pad to the arm's whole horizon so every
+    # interval refresh over the growing history reuses ONE compiled
+    # pad (the serve/soak modes' min_epoch_batches discipline).
+    min_epoch = 1
+    while min_epoch * 8192 < budget + 2 * n_per_request:
+        min_epoch *= 2
+
+    def play_round():
+        status, resp = client.propose_matches(n_per_request, policy=policy)
+        if status != 200:
+            raise RuntimeError(f"/match answered {status}: {resp}")
+        rows = resp["proposals"]
+        a = np.asarray([r["a"] for r in rows], np.int64)
+        b = np.asarray([r["b"] for r in rows], np.int64)
+        p_a = 1.0 / (1.0 + np.exp(strength[b] - strength[a]))
+        a_wins = rng.random(a.size) < p_a
+        winners = np.where(a_wins, a, b).astype(np.int32)
+        losers = np.where(a_wins, b, a).astype(np.int32)
+        status, _resp = client.submit(
+            winners, losers, producer=f"selfplay-{policy}"
+        )
+        if status != net.server.STATUS_ACCEPTED:
+            raise RuntimeError(f"/submit answered {status}")
+        frontdoor.flush()
+        return int(a.size)
+
+    try:
+        # Warmup: one full loop turn compiles the update bucket and the
+        # pair-scoring kernel; the first interval refresh compiles the
+        # bootstrap pad. Only then does the sentinel arm.
+        submitted = play_round()
+        srv.refresh_intervals(
+            num_rounds=bootstrap_rounds, seed=seed,
+            min_epoch_batches=min_epoch,
+        )
+        sentinel = sanitize.RecompileSentinel(**{
+            "update": eng.num_compiles,
+            "bootstrap": eng.num_bootstrap_compiles,
+            "matchmaker": matchmaker.num_compiles,
+        })
+
+        matches_to_corr = None
+        streak = 0
+        streak_start = None
+        iterations = 0
+        corr = 0.0
+        t0 = time.perf_counter()
+        while submitted < budget:
+            submitted += play_round()
+            iterations += 1
+            if iterations % refresh_every == 0:
+                srv.refresh_intervals(
+                    num_rounds=bootstrap_rounds, seed=seed,
+                    min_epoch_batches=min_epoch,
+                )
+            ratings_now, _wm = eng.ratings_snapshot()
+            corr = _spearman(np.asarray(ratings_now, np.float64), strength)
+            if corr >= corr_threshold:
+                if streak == 0:
+                    streak_start = submitted
+                streak += 1
+                if streak >= sustain:
+                    # Converged: the streak's FIRST check is the count.
+                    matches_to_corr = streak_start
+                    break
+            else:
+                streak = 0
+                streak_start = None
+        elapsed = time.perf_counter() - t0
+        final_ratings = np.asarray(eng.ratings_snapshot()[0]).copy()
+        mm_stats = srv.stats()["net"]["matchmaker"]
+        return {
+            "policy": policy,
+            "matches_to_corr": matches_to_corr,
+            "final_corr": round(corr, 4),
+            "submitted": submitted,
+            "iterations": iterations,
+            "elapsed_s": round(elapsed, 3),
+            "proposal_requests": mm_stats["requests"],
+            "proposals_served": mm_stats["proposals"],
+            "slo_alerts_fired": obs_live.slo.alerts_fired(),
+            "new_compiles": sentinel.new_compiles(),
+            "ratings": final_ratings,
+        }
+    finally:
+        client.close()
+        wire.close()
+        matchmaker.close()
+        frontdoor.close()
+        srv.close()
+
+
+def run_matchloop_benchmark():
+    """The matchmaking plane's acceptance harness: the deterministic
+    closed-loop self-play soak (module docstring, ninth mode). Runs the
+    active arm, the random control arm, and an active replay at equal
+    match budget, then applies the four HARD gates — convergence
+    advantage, seed-reproducibility, zero steady-state recompiles, and
+    SLO silence."""
+    players = _env_int("ARENA_BENCH_MATCHLOOP_PLAYERS", 64)
+    n_per_request = _env_int("ARENA_BENCH_MATCHLOOP_PROPOSALS", 16)
+    budget = _env_int("ARENA_BENCH_MATCHLOOP_BUDGET", 20_000)
+    corr_threshold = float(os.environ.get("ARENA_BENCH_MATCHLOOP_CORR", 0.95))
+    sustain = _env_int("ARENA_BENCH_MATCHLOOP_SUSTAIN", 6)
+    refresh_every = _env_int("ARENA_BENCH_MATCHLOOP_REFRESH_EVERY", 8)
+    bootstrap_rounds = _env_int("ARENA_BENCH_BOOTSTRAP_ROUNDS", 8)
+    min_advantage = float(
+        os.environ.get("ARENA_BENCH_MATCHLOOP_MIN_ADVANTAGE", 1.1)
+    )
+    slo_threshold_s = float(os.environ.get("ARENA_BENCH_MATCHLOOP_SLO_S", 0.25))
+    seed = _env_int("ARENA_BENCH_SEED", 0)
+
+    arm_args = (players, n_per_request, budget, corr_threshold, sustain,
+                refresh_every, bootstrap_rounds, seed, slo_threshold_s)
+    active = _run_matchloop_arm("active", *arm_args)
+    random_arm = _run_matchloop_arm("random", *arm_args)
+    replay = _run_matchloop_arm("active", *arm_args)
+
+    # --- seed-reproducibility HARD gate ------------------------------
+    ratings_equal = bool(
+        np.array_equal(active["ratings"], replay["ratings"])
+    )
+    if not ratings_equal or active["matches_to_corr"] != replay["matches_to_corr"]:
+        raise MatchloopGateError(
+            "the closed loop is not seed-reproducible: two identical "
+            f"active arms diverged (ratings bit-equal: {ratings_equal}, "
+            f"matches-to-threshold {active['matches_to_corr']} vs "
+            f"{replay['matches_to_corr']}) — the `# deterministic` "
+            "apply/propose contracts promise bit-identical replays at "
+            "a fixed seed"
+        )
+
+    # --- recompile + SLO-silence HARD gates, every arm ---------------
+    for arm in (active, random_arm, replay):
+        if arm["new_compiles"]:
+            raise MatchloopGateError(
+                f"steady-state recompiles in the {arm['policy']} arm: "
+                f"{arm['new_compiles']} — every proposal/update/"
+                "bootstrap shape must be warmed before the sentinel arms"
+            )
+        if arm["slo_alerts_fired"]:
+            raise MatchloopGateError(
+                f"{arm['slo_alerts_fired']} SLO alert(s) fired during "
+                f"the {arm['policy']} arm — the soak requires the "
+                "burn-rate engine silent throughout"
+            )
+
+    # --- the convergence HARD gate: active beats random --------------
+    if active["matches_to_corr"] is None:
+        raise MatchloopGateError(
+            "active sampling never reached rank correlation "
+            f"{corr_threshold:g} within the {budget}-match budget "
+            f"(final {active['final_corr']}) — no convergence claim "
+            "can be made"
+        )
+    random_reached = random_arm["matches_to_corr"]
+    # A random arm that never converged still spent its whole budget:
+    # score the advantage against that spend (a lower bound).
+    random_effective = (
+        random_reached if random_reached is not None
+        else random_arm["submitted"]
+    )
+    advantage = random_effective / active["matches_to_corr"]
+    if advantage < min_advantage:
+        raise MatchloopGateError(
+            f"uncertainty-driven sampling reached correlation "
+            f"{corr_threshold:g} in {active['matches_to_corr']} matches "
+            f"vs {random_effective} for random pairing ({advantage:.2f}x "
+            f"< the {min_advantage:g}x floor) — active sampling must be "
+            "measurably faster than random at equal budget"
+        )
+
+    def _arm_block(arm):
+        return {
+            k: v for k, v in arm.items()
+            if k not in ("ratings", "new_compiles")
+        }
+
+    return {
+        "metric": "arena_matchloop",
+        "value": round(advantage, 3),
+        "unit": "x_fewer_matches_vs_random",
+        "vs_baseline": None,
+        "params": {
+            "players": players,
+            "proposals_per_request": n_per_request,
+            "budget_matches": budget,
+            "corr_threshold": corr_threshold,
+            "sustain_checks": sustain,
+            "refresh_every": refresh_every,
+            "bootstrap_rounds": bootstrap_rounds,
+            "min_advantage": min_advantage,
+            "slo_threshold_s": slo_threshold_s,
+            "seed": seed,
+            "host_cores": os.cpu_count() or 1,
+        },
+        "matchloop": {
+            "active": _arm_block(active),
+            "random": _arm_block(random_arm),
+            "random_converged": random_reached is not None,
+            "advantage": round(advantage, 3),
+            "deterministic_replay_ok": True,  # bit-equal replay, gated
+            "steady_state_new_compiles": 0,  # sentinel gate raised otherwise
+            "slo_alerts_fired": 0,  # silence gate raised otherwise
+        },
+        "equivalence_ok": True,
+        "max_rating_diff": 0.0,  # np.array_equal replay, gated above
+    }
+
+
 def main() -> int:
     rc = 0
     mode = os.environ.get("ARENA_BENCH_MODE", "elo")
@@ -2486,6 +2823,7 @@ def main() -> int:
         "frontend": (run_frontend_benchmark, "wire_queries_per_s"),
         "replica": (run_replica_benchmark, "replica_queries_per_s"),
         "tenant": (run_tenant_benchmark, "x_vs_dedicated_engines"),
+        "matchloop": (run_matchloop_benchmark, "x_fewer_matches_vs_random"),
     }
     runner, unit = runners.get(mode, (run_benchmark, "x_vs_naive_baseline"))
     try:
@@ -2577,6 +2915,21 @@ def main() -> int:
         line = json.dumps(
             {
                 "metric": "arena_bench_tenant_gate_failure",
+                "value": -1,
+                "unit": unit,
+                "vs_baseline": None,
+                "error": str(exc),
+                "debug_bundle": _gate_debug_bundle(mode),
+            }
+        )
+        rc = EXIT_EQUIVALENCE_FAILURE
+    except MatchloopGateError as exc:
+        # The closed-loop soak's contract broke (convergence advantage,
+        # seed-reproducibility, recompile, SLO silence): a measured
+        # verdict, never a crash.
+        line = json.dumps(
+            {
+                "metric": "arena_bench_matchloop_gate_failure",
                 "value": -1,
                 "unit": unit,
                 "vs_baseline": None,

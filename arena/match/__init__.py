@@ -1,0 +1,37 @@
+"""arena.match: the matchmaking plane (see `arena.match.matchmaker`).
+
+Proposes policy-ranked pairings off one immutable `ServingView`; served
+over the wire as `GET /match?n=&tenant=&policy=` when a `Matchmaker` is
+attached to `ArenaHTTPServer`, and exercised end to end by the
+closed-loop self-play soak (`ARENA_BENCH_MODE=matchloop`).
+"""
+
+from arena.match.matchmaker import (
+    DEFAULT_EPSILON,
+    DEFAULT_POLICY,
+    DEFAULT_PROPOSALS,
+    DEFAULT_UCB_C,
+    EXPLORATION_FLOOR,
+    MAX_CANDIDATES,
+    MAX_PROPOSALS,
+    POLICIES,
+    Matchmaker,
+    pair_components,
+    propose_pairs,
+    render_match_payload,
+)
+
+__all__ = [
+    "DEFAULT_EPSILON",
+    "DEFAULT_POLICY",
+    "DEFAULT_PROPOSALS",
+    "DEFAULT_UCB_C",
+    "EXPLORATION_FLOOR",
+    "MAX_CANDIDATES",
+    "MAX_PROPOSALS",
+    "POLICIES",
+    "Matchmaker",
+    "pair_components",
+    "propose_pairs",
+    "render_match_payload",
+]

@@ -1,0 +1,366 @@
+"""The matchmaking plane: propose pairings off ONE immutable view.
+
+Every subsystem before this one *observes* matches; the `Matchmaker`
+*schedules* them. It answers "which n pairs should play next?" from a
+single `ServingView` — live ratings plus the bootstrap confidence
+intervals the view carries after `refresh_intervals()` — so a proposal
+batch is a pure function of (view, n, policy, tenant) and nothing else.
+That purity is the whole acceptance story: the closed-loop self-play
+soak (`ARENA_BENCH_MODE=matchloop`) replays bit-identically at a fixed
+seed because nothing in here reads a clock, an unseeded RNG, or
+mutable server state.
+
+Policy vocabulary (`POLICIES`):
+
+- ``fair``    — minimize pairwise win-prob skew: rank pairs by the
+  match-information term ``4*p*(1-p)`` (maximal at p=0.5), where p is
+  the same jitted Elo win-prob the /h2h endpoint serves.
+- ``active``  — uncertainty-driven active sampling: weight fairness by
+  the pair's combined CI width, so the pairs that shrink the widest
+  intervals fastest rank first. Degrades to ``fair`` when intervals
+  have not been refreshed yet (all widths equal).
+- ``ucb``     — exploration bonus: active's score plus a UCB-style
+  ``c * sqrt(log1p(total) / (n_i + n_j + 1))`` term that surfaces
+  under-played players.
+- ``epsilon`` — active's ranking with per-slot epsilon-random
+  replacement, seeded from the view watermark.
+- ``random``  — uniform distinct pairs, watermark-seeded: the control
+  arm the matchloop bench measures active sampling against.
+
+The pairwise matrices are computed through one jitted kernel over
+pow2-bucketed candidate arrays (`engine.bucket_size`, v3 lint), so a
+steady-state roster never recompiles; selection (triangle extraction,
+stable argsort, RNG) is host-side numpy and deterministic.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from arena import engine as engine_mod
+from arena import ratings
+from arena.obs import slo as slo_mod
+
+POLICIES = ("random", "fair", "active", "epsilon", "ucb")
+DEFAULT_POLICY = "active"
+DEFAULT_PROPOSALS = 16
+MAX_PROPOSALS = 1024
+# Proposal scoring is O(candidates^2): scope to a tenant past this.
+MAX_CANDIDATES = 2048
+DEFAULT_EPSILON = 0.1
+DEFAULT_UCB_C = 0.5
+# Additive weight floor (rating points) under Boltzmann selection.
+# Pure overlap weighting starves a confidently-WRONG player: once its
+# misplaced interval stops overlapping its true neighbours, the
+# corrective match is never scheduled and the error freezes in. The
+# floor keeps every pair's selection probability bounded away from
+# zero, so the closed loop keeps auditing "settled" pairs at a low
+# rate — the matchloop bench measures this as active holding its lead
+# over random instead of plateauing below the correlation threshold.
+EXPLORATION_FLOOR = 20.0
+# Domain-separates proposal RNG streams from every other consumer of
+# watermark-derived seeds (e.g. bootstrap resampling).
+_RNG_SALT = 0x6D617463
+
+
+def pair_components(ratings_vec, widths, counts, scale):  # deterministic
+    """All-pairs scoring ingredients as (B, B) matrices: win prob
+    ``p[i, j] = P(i beats j)`` via the same jitted Elo expectation the
+    h2h path uses, the fairness/information term ``4*p*(1-p)``, the
+    combined CI width, and the UCB exploration bonus. One fused kernel
+    per pow2 bucket; padded tail entries are masked out host-side by
+    the triangle extraction, so their values never rank."""
+    p = ratings.elo_expected(ratings_vec[:, None], ratings_vec[None, :],
+                             scale=scale)
+    info = 4.0 * p * (1.0 - p)
+    # A never-played player's BOOTSTRAP width is zero (its rating is
+    # constant across replicates) — but it is maximally uncertain, not
+    # maximally certain. Blend in a prior width that decays with match
+    # count so unplayed players rank as the widest intervals of all
+    # instead of never being scheduled.
+    eff = widths + scale / (1.0 + counts)
+    width = eff[:, None] + eff[None, :]
+    # CI-overlap: how ambiguous the pair's ORDER still is. Centering
+    # each effective interval on its rating, two intervals overlap by
+    # half the combined width minus the rating gap — zero once the
+    # pair is confidently ordered. This is the active policy's target:
+    # a match between still-overlapping intervals is the one that
+    # shrinks ranking uncertainty fastest; a match between separated
+    # intervals teaches nothing the view didn't already serve.
+    gap = jnp.abs(ratings_vec[:, None] - ratings_vec[None, :])
+    overlap = jnp.maximum(width / 2.0 - gap, 0.0)
+    total = jnp.log1p(jnp.sum(counts))
+    bonus = jnp.sqrt(total / (counts[:, None] + counts[None, :] + 1.0))
+    return p, info, width, overlap, bonus
+
+
+def _policy_scores(policy, info, width, overlap, bonus, ucb_c):  # deterministic
+    """The pluggable ranking surface. `epsilon` ranks by `active` (its
+    exploration happens at slot level in `propose_pairs`); `random`
+    never reaches here."""
+    if policy == "fair":
+        return info
+    if policy == "active":
+        return overlap
+    if policy == "ucb":
+        return overlap * (1.0 + ucb_c * bonus)
+    raise ValueError(f"policy {policy!r} has no score surface")
+
+
+def _greedy_matching(flat, iu, ju, take):  # deterministic
+    """Select `take` pair indices by score, matching-round constrained:
+    within one round no player appears twice, and a new round opens
+    only when no admissible pair is left. Without this, uncertainty
+    weighting degenerates — the widest-CI player lands in every
+    proposed pair and the rest of the roster starves (exactly the
+    over-concentration the matchloop bench would catch as active
+    losing to random). Ties and rounds are ordered by stable argsort,
+    so selection is deterministic at a fixed view."""
+    order = np.argsort(-flat, kind="stable")
+    picks = []
+    taken = np.zeros(order.size, bool)
+    while len(picks) < take:
+        used = set()
+        progressed = False
+        for k in order:
+            if taken[k]:
+                continue
+            a, b = int(iu[k]), int(ju[k])
+            if a in used or b in used:
+                continue
+            picks.append(int(k))
+            taken[k] = True
+            used.add(a)
+            used.add(b)
+            progressed = True
+            if len(picks) == take:
+                break
+        if not progressed:
+            break  # every remaining pair is taken
+    return np.asarray(picks, np.int64)
+
+
+def _pad(vec, bucket):
+    out = np.zeros(bucket, np.float32)
+    out[: vec.size] = vec
+    return out
+
+
+def propose_pairs(view, n, policy, pair_fn, tenant=None,
+                  epsilon=DEFAULT_EPSILON, ucb_c=DEFAULT_UCB_C):  # deterministic
+    """Propose up to `n` distinct pairings `(a, b, p_a_beats_b, score)`
+    from one immutable view — tenant-local player ids when `tenant=`
+    is given, composite ids otherwise. Deterministic at a fixed view:
+    the RNG behind `random`/`epsilon` is seeded from
+    (salt, watermark, n, policy, tenant), and ranking ties break by
+    stable argsort over the pair triangle."""
+    if tenant is None:
+        off, num = 0, int(view.ratings.size)
+    else:
+        tenant = int(tenant)
+        if not 0 <= tenant < view.num_tenants:
+            raise ValueError(
+                f"unknown tenant {tenant}: this arena serves tenants "
+                f"[0, {view.num_tenants})"
+            )
+        num = int(view.players_per_tenant)
+        off = tenant * num
+    n = int(n)
+    if n < 0:
+        raise ValueError(f"n must be >= 0, got {n}")
+    if n > MAX_PROPOSALS:
+        raise ValueError(f"n must be <= {MAX_PROPOSALS}, got {n}")
+    if num > MAX_CANDIDATES:
+        raise ValueError(
+            f"{num} candidates exceeds the {MAX_CANDIDATES}-player "
+            "proposal ceiling (scoring is all-pairs); scope the request "
+            "with tenant="
+        )
+    if n == 0 or num < 2:
+        return []
+
+    ratings_vec = np.asarray(view.ratings[off:off + num], np.float32)
+    if view.lo is None:
+        # Intervals never refreshed: every CI is equally unknown, so
+        # `active` degrades to `fair` instead of refusing to serve.
+        widths = np.ones(num, np.float32)
+    else:
+        widths = np.asarray(
+            view.hi[off:off + num] - view.lo[off:off + num], np.float32
+        )
+    counts = np.asarray(
+        view.wins[off:off + num] + view.losses[off:off + num], np.float32
+    )
+    bucket = engine_mod.bucket_size(num)
+    p, info, width, overlap, bonus = (
+        np.asarray(m)[:num, :num]
+        for m in pair_fn(
+            _pad(ratings_vec, bucket), _pad(widths, bucket),
+            _pad(counts, bucket),
+        )
+    )
+
+    rng = np.random.default_rng([
+        _RNG_SALT, int(view.watermark), n, POLICIES.index(policy),
+        int(view.num_tenants) if tenant is None else tenant,
+    ])
+    iu, ju = np.triu_indices(num, k=1)
+    take = min(n, int(iu.size))
+    if policy == "random":
+        picks = rng.choice(iu.size, size=take, replace=False)
+        score = np.zeros_like(p)
+    else:
+        rank_by = "active" if policy == "epsilon" else policy
+        score = _policy_scores(rank_by, info, width, overlap, bonus, ucb_c)
+        flat = score[iu, ju]
+        if rank_by == "fair":
+            # Skew minimization is a deterministic objective: take the
+            # fairest admissible pairs outright.
+            keys = flat
+        else:
+            # Boltzmann exploration (Gumbel-perturbed log-weights):
+            # sample pairs with probability proportional to their
+            # score instead of taking the argmax. Early on every CI
+            # overlaps every other, so this mixes across the whole
+            # ladder like the random arm; as intervals separate, the
+            # weight mass concentrates on the still-ambiguous pairs.
+            # Seeded by the view watermark, so still deterministic.
+            # EXPLORATION_FLOOR keeps confidently-separated pairs
+            # auditable (see its definition above).
+            keys = np.log(flat + EXPLORATION_FLOOR) + rng.gumbel(size=flat.size)
+        picks = _greedy_matching(keys, iu, ju, take)
+        if policy == "epsilon":
+            explore = rng.random(take) < epsilon
+            randoms = rng.choice(iu.size, size=take, replace=False)
+            picks = np.where(explore, randoms, picks)
+    return [
+        (int(iu[k]), int(ju[k]), float(p[iu[k], ju[k]]),
+         float(score[iu[k], ju[k]]))
+        for k in picks
+    ]
+
+
+def render_match_payload(view, stale, policy, n, tenant, rows):  # pure-render(view); schema: wire-match@v1
+    """The GET /match payload off one view: the standard staleness
+    header fields plus the proposal rows. The payload's own
+    ``watermark`` is the proposing view's — `make_response` promotes it
+    into the envelope, so a client sees exactly which watermark the
+    proposals were ranked at."""
+    out = {
+        "watermark": view.watermark,
+        "matches_ingested": view.matches_ingested,
+        "staleness": view.matches_ingested - view.watermark,
+        "stale": stale,
+        "view_seq": view.seq,
+        "policy": policy,
+        "n": int(n),
+        "proposals": [
+            {
+                "a": a,
+                "b": b,
+                "p_a_beats_b": p_ab,
+                "score": score,
+            }
+            for a, b, p_ab, score in rows
+        ],
+    }
+    if tenant is not None:
+        out["tenant"] = int(tenant)
+    return out
+
+
+class Matchmaker:  # protocol: close
+    """The matchmaking plane over one `ArenaServer`: serves policy-
+    ranked pairing proposals off the server's immutable views, counts
+    and times every proposal through the server's one registry, and
+    registers the `match-proposal-latency` SLO objective on the
+    server's burn-rate engine.
+
+    Instrumentation (all in the server's registry, so `stats()["net"]`
+    and /metrics see them with zero extra plumbing):
+
+    - ``arena_match_requests_total`` / ``arena_match_proposals_total``
+    - ``arena_match_proposal_latency_seconds`` (exemplar-bearing
+      histogram, the SLO objective's selector)
+    - ``arena_matchmaker_present`` gauge (1 while attached, 0 after
+      `close()` — the stats()/healthz presence bit)
+    """
+
+    def __init__(self, server, default_policy=DEFAULT_POLICY,
+                 epsilon=DEFAULT_EPSILON, ucb_c=DEFAULT_UCB_C,
+                 slo_threshold_s=slo_mod.DEFAULT_MATCH_PROPOSAL_LATENCY_S):
+        if default_policy not in POLICIES:
+            raise ValueError(
+                f"unknown default policy {default_policy!r}: one of "
+                f"{POLICIES}"
+            )
+        self.server = server
+        self.obs = server.obs
+        self.default_policy = default_policy
+        self.epsilon = float(epsilon)
+        self.ucb_c = float(ucb_c)
+        # One jitted kernel, one compile cache: `num_compiles()` is the
+        # matchloop sentinel's per-bucket recompile probe.
+        self._pair_fn = jax.jit(
+            partial(pair_components, scale=float(server.engine.scale))
+        )
+        self._c_requests = self.obs.counter("arena_match_requests_total")
+        self._c_proposals = self.obs.counter("arena_match_proposals_total")
+        self._h_latency = self.obs.histogram(
+            "arena_match_proposal_latency_seconds"
+        )
+        self._g_present = self.obs.gauge("arena_matchmaker_present")
+        self._g_present.set(1)
+        if self.obs.slo is not None:
+            try:
+                self.obs.slo.add(
+                    slo_mod.match_proposal_latency_slo(slo_threshold_s)
+                )
+            except slo_mod.SLOError:
+                pass  # a second matchmaker keeps the first objective
+
+    def num_compiles(self):
+        """Compile-cache size of the pair-scoring kernel (one entry per
+        pow2 bucket) — what the matchloop recompile sentinel watches."""
+        return self._pair_fn._cache_size()
+
+    def propose(self, n, policy=None, tenant=None):
+        """Propose `n` pairings; returns (view, stale, policy, rows).
+        Counts the request, times it into the SLO objective's
+        histogram, and tags the latency exemplar with the request's
+        trace."""
+        policy = self.default_policy if policy is None else policy
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown match policy {policy!r}: one of {POLICIES}"
+            )
+        t0 = time.perf_counter()
+        with self.obs.span("match.propose") as span:
+            view, stale = self.server._serve_view()
+            rows = propose_pairs(
+                view, n, policy, self._pair_fn, tenant=tenant,
+                epsilon=self.epsilon, ucb_c=self.ucb_c,
+            )
+            self._c_requests.inc()
+            self._c_proposals.inc(len(rows))
+            self._h_latency.record(
+                time.perf_counter() - t0, trace_id=span.trace_id
+            )
+        return view, stale, policy, rows
+
+    def propose_payload(self, n, policy=None, tenant=None):
+        """`propose()` rendered as the wire-match@v1 payload — what the
+        /match endpoint returns on both front ends."""
+        view, stale, policy, rows = self.propose(
+            n, policy=policy, tenant=tenant
+        )
+        return render_match_payload(view, stale, policy, n, tenant, rows)
+
+    def close(self):
+        """Terminal: drop the presence gauge to 0 (stats()["net"] and
+        /healthz report the matchmaker gone). The jit cache and
+        registry instruments need no teardown."""
+        self._g_present.set(0)

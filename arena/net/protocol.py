@@ -39,10 +39,18 @@ ENDPOINTS = (
     # log by sequence number (or align a restored snapshot by
     # watermark) and replay it strictly in order.
     "log",
+    # The matchmaking plane (PR 20): policy-ranked pairing proposals
+    # off one immutable view (503 when no Matchmaker is attached).
+    "match",
 )
 
 # Default leaderboard page when the query string omits one.
 DEFAULT_PAGE_LIMIT = 50
+
+# Default /match proposal count when the query string omits n=.
+# (Kept here, not imported from arena.match: this module stays free of
+# jax imports so clients parse without touching the kernel stack.)
+DEFAULT_MATCH_PROPOSALS = 16
 
 # Batched /query bound: a request is one view read, not a denial-of-
 # service vector — more lookups than this is a 400, not a slow answer.
@@ -128,6 +136,15 @@ def parse_path(method, path):
     elif route == "h2h" and len(parts) == 1:
         endpoint, want = "h2h", "GET"
         parsed = {"a": _query_int(params, "a"), "b": _query_int(params, "b")}
+        _parse_tenant(params, parsed)
+    elif route == "match" and len(parts) == 1:
+        endpoint, want = "match", "GET"
+        parsed = {"n": _query_int(params, "n", DEFAULT_MATCH_PROPOSALS)}
+        # The policy is a string knob, not an int: pass it through
+        # verbatim and let the matchmaker's closed vocabulary 400 it.
+        policy = params.get("policy", [None])[0]
+        if policy is not None:
+            parsed["policy"] = policy
         _parse_tenant(params, parsed)
     elif route == "submit" and len(parts) == 1:
         endpoint, want = "submit", "POST"
@@ -391,6 +408,20 @@ class WireClient:
         `parse_query_body` schema); the response's "results" list is
         index-aligned with it, every entry answered from one view."""
         return self.post("/query", {"queries": list(queries)})
+
+    def propose_matches(self, n, policy=None, tenant=None):  # schema: wire-match@v1
+        """GET /match on the persistent connection (mirrors
+        `batch_query`): up to `n` policy-ranked pairing proposals from
+        the server's matchmaker. `policy=` picks from the matchmaker's
+        vocabulary (server 400s unknown names); `tenant=` scopes the
+        candidate set to one tenant's arena. 503 when the server has
+        no matchmaker attached."""
+        query = [f"n={int(n)}"]
+        if policy is not None:
+            query.append(f"policy={policy}")
+        if tenant is not None:
+            query.append(f"tenant={int(tenant)}")
+        return self.get("/match?" + "&".join(query))
 
     def submit(self, winners, losers, producer="local", tenant=None,
                category=None):  # schema: wire-submit-request@v1

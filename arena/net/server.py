@@ -7,6 +7,7 @@ serving responses over these endpoints:
     GET  /leaderboard?offset=&limit=  one descending-rating page
     GET  /player/{id}                 one player's rating row (+ CI)
     GET  /h2h?a=&b=                   Elo P(a beats b)
+    GET  /match?n=&tenant=&policy=    policy-ranked pairing proposals
     POST /query                       many lookups, ONE view (batched)
     POST /submit                      admit one batch at the front door
     GET  /stats                       the registry's Prometheus render()
@@ -139,6 +140,8 @@ def _dispatch(wire, endpoint, params, body_raw):
         return _submit(wire, body_raw)
     if endpoint == "log":
         return 200, _log_payload(wire, params)
+    if endpoint == "match":
+        return 200, _match_payload(wire, params)
     if endpoint == "debug_window":
         return 200, wire.obs.windows.read()
     if endpoint == "debug_slo":
@@ -150,11 +153,28 @@ def _dispatch(wire, endpoint, params, body_raw):
     raise protocol.ProtocolError(404, f"no such endpoint: {endpoint!r}")
 
 
+def _match_payload(wire, params):
+    """GET /match: the matchmaking plane. 503 when no `Matchmaker` is
+    attached (read-only deployments serve everything else unchanged);
+    the payload itself is rendered by
+    `arena.match.render_match_payload` off one immutable view."""
+    matchmaker = wire.matchmaker
+    if matchmaker is None:
+        raise protocol.ProtocolError(
+            503, "this server has no matchmaker attached"
+        )
+    return matchmaker.propose_payload(
+        params["n"], policy=params.get("policy"),
+        tenant=params.get("tenant"),
+    )
+
+
 def _healthz_payload(wire):  # schema: wire-healthz@v1
     srv = wire.server
     return {
         "status": "ok",
         "front_end": wire.front_end,
+        "matchmaker": wire.matchmaker is not None,
         "players": srv.engine.num_players,
         "matches_ingested": srv.engine.matches_ingested,
     }
@@ -318,9 +338,12 @@ class ArenaHTTPServer:  # protocol: start->close
                  cache_capacity=fastpath.DEFAULT_CACHE_CAPACITY,
                  prerender_pages=fastpath.DEFAULT_PRERENDER_PAGES,
                  submit_workers=fastpath.DEFAULT_SUBMIT_WORKERS,
-                 time_travel=None, categories=None):
+                 time_travel=None, categories=None, matchmaker=None):
         self.server = server
         self.frontdoor = frontdoor
+        # Optional `arena.match.Matchmaker`: the matchmaking plane
+        # behind GET /match. Without one, /match answers 503.
+        self.matchmaker = matchmaker
         # Optional `arena.tenancy.CategoryRegistry`: lets /submit name
         # a tenant by category ("coding", "creative-writing", ...) —
         # the LMSYS per-category slice use-case. Without one, category

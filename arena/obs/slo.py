@@ -199,6 +199,29 @@ def default_slos():
 DEFAULT_REPLICA_STALENESS_MATCHES = 10_000
 
 
+# Proposal scoring is one bucketed kernel call plus a triangle argsort
+# off an already-built view: a quarter second is a stuck tail, not a
+# busy one.
+DEFAULT_MATCH_PROPOSAL_LATENCY_S = 0.25
+
+
+def match_proposal_latency_slo(threshold_s=DEFAULT_MATCH_PROPOSAL_LATENCY_S,
+                               target=0.99):
+    """The matchmaking plane's burn-rate objective: 99% of /match
+    proposal computations (recorded into
+    `arena_match_proposal_latency_seconds` by `Matchmaker.propose`)
+    must finish within `threshold_s`. Registered by the `Matchmaker`
+    constructor via `SLOEngine.add`, so it appears on /debug/slo only
+    where a matchmaker is actually attached — and the matchloop soak
+    hard-gates on it never firing."""
+    return SLO(
+        "match-proposal-latency",
+        target=target,
+        latency=Selector("arena_match_proposal_latency_seconds"),
+        threshold_s=float(threshold_s),
+    )
+
+
 def replica_staleness_slo(threshold_matches=DEFAULT_REPLICA_STALENESS_MATCHES,
                           target=0.99):
     """Per-replica staleness as a burn-rate objective: 99% of the

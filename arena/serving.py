@@ -906,6 +906,22 @@ class ArenaServer:  # protocol: close
                         "arena_wire_cache_age_seconds"
                     ).value,
                 },
+                # The matchmaking plane (PR 20): presence bit (the
+                # `arena_matchmaker_present` gauge a `Matchmaker` sets
+                # on attach and zeroes on close) plus proposal
+                # counters. Zeros until a matchmaker attaches; same
+                # one registry.
+                "matchmaker": {
+                    "present": bool(
+                        reg.gauge("arena_matchmaker_present").value
+                    ),
+                    "requests": reg.counter_sum(
+                        "arena_match_requests_total"
+                    ),
+                    "proposals": reg.counter_sum(
+                        "arena_match_proposals_total"
+                    ),
+                },
             },
             # The live ops plane (PR 13): burn-rate evaluation over
             # the sliding windows, plus window/profiler thread health.

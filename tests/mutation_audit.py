@@ -892,6 +892,45 @@ MUTATIONS = (
         "test_wire_unknown_tenant_rejected (tenant 5 and 99 on a "
         "3-tenant arena must 400 on every endpoint and apply nothing)",
     ),
+    (
+        "proposal-ignores-CI-width",
+        "arena/match/matchmaker.py",
+        "    eff = widths + scale / (1.0 + counts)",
+        "    eff = scale / (1.0 + counts)",
+        "the effective uncertainty must blend the live bootstrap widths "
+        "with the count-decaying prior: drop the widths and the active "
+        "policy ranks by match count alone, so a settled-but-wide "
+        "interval never attracts the match that would shrink it — "
+        "killed by test_pair_components_matches_numpy_oracle (the "
+        "combined-width and overlap surfaces must equal the numpy "
+        "oracle that includes the widths term)",
+    ),
+    (
+        "closed-loop-gate-skipped",
+        "arena/bench_arena.py",
+        "    if advantage < min_advantage:",
+        "    if False:",
+        "the matchloop's convergence verdict is the PR's acceptance "
+        "criterion: skip the advantage comparison and an active policy "
+        "that converges SLOWER than random pairing still exits 0 with "
+        "a green arena_matchloop line — killed by "
+        "test_matchloop_convergence_gate_is_hard (an impossible "
+        "MIN_ADVANTAGE must produce rc 2 and the "
+        "arena_bench_matchloop_gate_failure line, never a result line)",
+    ),
+    (
+        "match-envelope-omits-watermark",
+        "arena/match/matchmaker.py",
+        '        "watermark": view.watermark,',
+        '        "view_watermark": view.watermark,',
+        "the /match payload's watermark is what make_response promotes "
+        "into the envelope: rename it and the envelope silently falls "
+        "back to the LIVE matches_applied counter, stamping proposals "
+        "with freshness the proposing view does not have — killed by "
+        "test_match_envelope_watermark_is_the_views (under a staleness "
+        "allowance the envelope watermark must equal the view's, not "
+        "the live counter's)",
+    ),
 )
 
 

@@ -124,6 +124,9 @@ def test_clean_pass_is_not_vacuous():
         "arena/net/replica.py": (
             "ReplicaReader", [("start", "close")], set(),
         ),
+        # PR 20: the matchmaker's close is terminal-only (it drops the
+        # presence gauge; the jit cache needs no teardown).
+        "arena/match/matchmaker.py": ("Matchmaker", [], {"close"}),
     }
     for rel, (cls_name, pairs, terminal) in protocols.items():
         path = REPO / rel
@@ -168,6 +171,14 @@ def test_clean_pass_is_not_vacuous():
         "arena/net/replica.py": {
             "ReplicaReader._apply_records": "deterministic",
         },
+        # PR 20: proposal selection is deterministic at a fixed view
+        # (watermark-seeded RNG), and the /match payload is a pure
+        # render off that view.
+        "arena/match/matchmaker.py": {
+            "pair_components": "deterministic",
+            "propose_pairs": "deterministic",
+            "render_match_payload": "pure_render",
+        },
     }
     for rel, expected in contracts.items():
         path = REPO / rel
@@ -208,6 +219,10 @@ def test_clean_pass_is_not_vacuous():
         },
         "arena/net/replica.py": {
             "SegmentCursor.fetch": ("wire-log-segment", 1),
+        },
+        # PR 20: the /match payload renderer — sidecar wire-match.
+        "arena/match/matchmaker.py": {
+            "render_match_payload": ("wire-match", 1),
         },
     }
     for rel, expected in schemas.items():
@@ -257,7 +272,8 @@ def test_project_table_covers_every_default_target_module():
     ]
     table = project.ProjectTable([c.symbols for c in contexts])
     for name in ("arena.ingest", "arena.pipeline", "arena.net.frontdoor",
-                 "arena.net.replica", "arena.obs.metrics", "arena.sharding"):
+                 "arena.net.replica", "arena.obs.metrics", "arena.sharding",
+                 "arena.match.matchmaker"):
         assert table.module(name) is not None, f"table lost {name}"
     # The sharding module's mesh is resolvable by name — what item 3's
     # multi-host modules will import.

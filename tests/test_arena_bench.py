@@ -685,6 +685,95 @@ def test_tenant_bench_full_size_hits_5x():
     assert result["tenant"]["bit_exact_tenants"] == 256
 
 
+MATCHLOOP_SMOKE_ENV = {
+    "ARENA_BENCH_MODE": "matchloop",
+    "ARENA_BENCH_MATCHLOOP_PLAYERS": "16",
+    "ARENA_BENCH_MATCHLOOP_PROPOSALS": "8",
+    "ARENA_BENCH_MATCHLOOP_BUDGET": "2000",
+    "ARENA_BENCH_MATCHLOOP_CORR": "0.9",
+    "ARENA_BENCH_MATCHLOOP_SUSTAIN": "3",
+    "ARENA_BENCH_MATCHLOOP_REFRESH_EVERY": "4",
+    "ARENA_BENCH_BOOTSTRAP_ROUNDS": "4",
+    # The advantage FLOOR is a full-size property (the toy ladder
+    # converges in a handful of rounds either way): the smoke checks
+    # the machinery — three closed HTTP loops, bit-equal replay,
+    # recompile sentinel, SLO silence — not the race margin.
+    "ARENA_BENCH_MATCHLOOP_MIN_ADVANTAGE": "0",
+}
+
+
+def test_matchloop_bench_smoke_contract():
+    """ARENA_BENCH_MODE=matchloop through the real entrypoint: one
+    JSON line, rc 0, the arena_matchloop metric with both arms
+    converged over real localhost HTTP, the replay arm bit-equal, zero
+    steady-state recompiles, and the SLO engine silent."""
+    result = run_bench(MATCHLOOP_SMOKE_ENV, timeout=300)
+    assert result["metric"] == "arena_matchloop"
+    assert result["unit"] == "x_fewer_matches_vs_random"
+    assert result["equivalence_ok"] is True
+    assert result["max_rating_diff"] == 0.0
+    assert result["value"] > 0
+    assert result["params"]["players"] == 16
+    assert result["params"]["sustain_checks"] == 3
+    loop = result["matchloop"]
+    assert loop["deterministic_replay_ok"] is True
+    assert loop["steady_state_new_compiles"] == 0
+    assert loop["slo_alerts_fired"] == 0
+    for arm in (loop["active"], loop["random"]):
+        assert arm["matches_to_corr"] is not None
+        assert arm["final_corr"] >= 0.9
+        assert arm["slo_alerts_fired"] == 0
+        # Every submitted match came from a served proposal.
+        assert arm["proposals_served"] == arm["submitted"]
+    assert loop["advantage"] == pytest.approx(
+        loop["random"]["matches_to_corr"] / loop["active"]["matches_to_corr"],
+        rel=1e-3,
+    )
+
+
+def test_matchloop_convergence_gate_is_hard(tmp_path):
+    """The named kill for closed-loop-gate-skipped: an impossible
+    MIN_ADVANTAGE must turn the run into
+    arena_bench_matchloop_gate_failure at rc 2 with a flight-recorder
+    bundle — never an arena_matchloop line. Skip the advantage
+    comparison and this becomes a green run."""
+    result = run_bench(
+        {
+            **MATCHLOOP_SMOKE_ENV,
+            "ARENA_BENCH_MATCHLOOP_MIN_ADVANTAGE": "1e9",
+            "ARENA_DEBUG_DIR": str(tmp_path),
+        },
+        timeout=300,
+        expect_rc=2,
+    )
+    assert result["metric"] == "arena_bench_matchloop_gate_failure"
+    assert result["value"] == -1
+    assert result["unit"] == "x_fewer_matches_vs_random"
+    assert "matchloop" not in result
+    assert "measurably faster" in result["error"]
+    bundle = pathlib.Path(result["debug_bundle"])
+    assert bundle.parent == tmp_path
+    assert (bundle / "metrics.json").exists()
+
+
+@pytest.mark.slow
+def test_matchloop_bench_full_size_beats_random():
+    """The acceptance run at the acceptance size: 64 players in four
+    hard tiers, active sampling reaching sustained 0.95 rank
+    correlation >= 1.1x fewer matches than random pairing at the same
+    20k budget, replay bit-equal, zero recompiles, SLOs silent."""
+    result = run_bench({"ARENA_BENCH_MODE": "matchloop"}, timeout=600)
+    assert result["metric"] == "arena_matchloop"
+    assert result["params"]["players"] == 64
+    assert result["params"]["budget_matches"] == 20_000
+    assert result["value"] >= 1.1
+    loop = result["matchloop"]
+    assert loop["random_converged"] in (True, False)
+    assert loop["active"]["matches_to_corr"] is not None
+    assert loop["deterministic_replay_ok"] is True
+    assert loop["steady_state_new_compiles"] == 0
+
+
 def test_bench_equivalence_failure_exits_nonzero_before_any_speedup():
     """The hard gate: with the tolerance forced to 0 the (real, tiny)
     float32-vs-float64 divergence trips it — one JSON line carrying the

@@ -1,0 +1,381 @@
+"""The matchmaking plane (arena/match/): policy math against a numpy
+oracle, watermark-seeded determinism, tenant scoping, the wire-match
+envelope, and the degenerate rosters.
+
+Two mutation-audit kills are named here:
+`test_pair_components_matches_numpy_oracle` (proposal-ignores-CI-width
+— drop the bootstrap widths from the effective-uncertainty blend and
+the overlap surface detaches from the oracle) and
+`test_match_envelope_watermark_is_the_views`
+(match-envelope-omits-watermark — rename the payload's watermark and
+the envelope silently falls back to the LIVE counter, claiming
+freshness the proposing view does not have).
+"""
+
+import numpy as np
+import pytest
+
+from arena import match as match_mod
+from arena.match import (
+    EXPLORATION_FLOOR,
+    MAX_PROPOSALS,
+    POLICIES,
+    Matchmaker,
+    pair_components,
+    propose_pairs,
+)
+from arena.net import ArenaHTTPServer, FrontDoor, WireClient
+from arena.obs import Observability
+from arena.serving import ArenaServer
+from arena.tenancy import MultiTenantEngine
+
+P = 40
+
+
+@pytest.fixture(scope="module")
+def stack():
+    obs = Observability()
+    srv = ArenaServer(num_players=P, max_staleness_matches=0, obs=obs)
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, P, 600).astype(np.int32)
+    b = ((a + 1 + rng.integers(0, P - 1, 600)) % P).astype(np.int32)
+    srv.engine.ingest(a, b)
+    srv.refresh_intervals(num_rounds=8, seed=0)
+    frontdoor = FrontDoor(srv.engine, capacity=16)
+    matchmaker = Matchmaker(srv)
+    server = ArenaHTTPServer(
+        srv, frontdoor=frontdoor, matchmaker=matchmaker
+    ).start()
+    client = WireClient(server.host, server.port)
+    yield server, client, matchmaker
+    client.close()
+    server.close()
+    matchmaker.close()
+    frontdoor.close()
+    srv.close()
+
+
+# --- the scoring kernel vs a numpy oracle ----------------------------------
+
+
+def test_pair_components_matches_numpy_oracle():
+    """Every (B, B) ingredient the policies rank by, recomputed in
+    plain numpy. The named kill for proposal-ignores-CI-width: the
+    effective uncertainty MUST blend the bootstrap widths with the
+    count-decaying prior — `widths + scale/(1+counts)` — or wide-CI
+    players stop outranking settled ones and the overlap surface
+    drifts off this oracle."""
+    rng = np.random.default_rng(3)
+    n, scale = 24, 400.0
+    r = rng.normal(1500.0, 120.0, n).astype(np.float32)
+    w = rng.uniform(0.0, 80.0, n).astype(np.float32)
+    c = rng.integers(0, 30, n).astype(np.float32)
+    p, info, width, overlap, bonus = (
+        np.asarray(m) for m in pair_components(r, w, c, scale=scale)
+    )
+    r64 = r.astype(np.float64)
+    want_p = 1.0 / (1.0 + 10.0 ** ((r64[None, :] - r64[:, None]) / scale))
+    assert np.allclose(p, want_p, atol=1e-5)
+    assert np.allclose(info, 4.0 * p * (1.0 - p), atol=1e-6)
+    eff = w + scale / (1.0 + c)
+    want_width = eff[:, None] + eff[None, :]
+    assert np.allclose(width, want_width, rtol=1e-5)
+    gap = np.abs(r64[:, None] - r64[None, :])
+    assert np.allclose(
+        overlap, np.maximum(want_width / 2.0 - gap, 0.0), rtol=1e-4,
+        atol=1e-3,
+    )
+    total = np.log1p(c.sum())
+    assert np.allclose(
+        bonus, np.sqrt(total / (c[:, None] + c[None, :] + 1.0)), rtol=1e-5
+    )
+    # The prior is the whole story for an unplayed player: zero
+    # bootstrap width, zero matches -> it must carry the LARGEST
+    # effective uncertainty on the board.
+    w2 = w.copy()
+    w2[0], c2 = 0.0, c.copy()
+    c2[0] = 0.0
+    _, _, width2, _, _ = (
+        np.asarray(m) for m in pair_components(r, w2, c2, scale=scale)
+    )
+    eff2 = np.diag(width2) / 2.0
+    assert eff2[0] == eff2.max()
+
+
+def test_fair_policy_concentrates_on_even_matches(stack):
+    """`fair` minimizes pairwise win-prob skew: its proposals' mean
+    |p - 0.5| sits well under the all-pairs mean, and no player is
+    proposed twice before every player has appeared once (the
+    matching-round constraint)."""
+    server, _client, matchmaker = stack
+    view, _stale, _pol, rows = matchmaker.propose(8, policy="fair")
+    skews = [abs(p - 0.5) for _a, _b, p, _s in rows]
+    r = np.asarray(view.ratings, np.float64)
+    scale = float(server.server.engine.scale)
+    all_p = 1.0 / (1.0 + 10.0 ** ((r[None, :] - r[:, None]) / scale))
+    iu, ju = np.triu_indices(P, k=1)
+    assert np.mean(skews) < np.mean(np.abs(all_p[iu, ju] - 0.5))
+    players = [x for a, b, _p, _s in rows for x in (a, b)]
+    assert len(players) == len(set(players)), "a player proposed twice"
+
+
+def test_policies_are_deterministic_at_a_fixed_watermark(stack):
+    """The `# deterministic` contract over the full policy surface:
+    at one view (one watermark) the same request replays bit-equal,
+    for every policy — the RNG is derived, not ambient."""
+    _server, client, matchmaker = stack
+    for policy in POLICIES:
+        _v, _s, _p, first = matchmaker.propose(6, policy=policy)
+        _v, _s, _p, again = matchmaker.propose(6, policy=policy)
+        assert first == again, policy
+        status, r1 = client.propose_matches(6, policy=policy)
+        assert status == 200
+        status, r2 = client.propose_matches(6, policy=policy)
+        assert r1["proposals"] == r2["proposals"], policy
+    # ... and the watermark is the seed: advancing it reshuffles the
+    # stochastic policies.
+    _v, _s, _p, before = matchmaker.propose(10, policy="random")
+    server = _server.server
+    server.engine.ingest(
+        np.arange(10, dtype=np.int32), np.arange(10, 20, dtype=np.int32)
+    )
+    _v, _s, _p, after = matchmaker.propose(10, policy="random")
+    assert before != after
+
+
+def test_active_scores_rank_overlapping_uncertain_pairs_first(stack):
+    """The active policy's rows carry the CI-overlap score it ranked
+    by (plus the Boltzmann floor's guarantee: scores are finite and
+    non-negative), and proposals respect the matching-round bound."""
+    _server, _client, matchmaker = stack
+    _v, _s, _p, rows = matchmaker.propose(8, policy="active")
+    assert rows
+    for a, b, p, score in rows:
+        assert 0 <= a < P and 0 <= b < P and a != b
+        assert 0.0 < p < 1.0
+        assert score >= 0.0
+    assert EXPLORATION_FLOOR > 0.0
+
+
+def test_tenant_scoping_proposes_tenant_local_pairs():
+    """`?tenant=` scopes proposals to one tenant's roster: ids are
+    tenant-local, win probs come from that tenant's ratings slice, and
+    an out-of-range tenant is the standard 400 reject."""
+    obs = Observability()
+    eng = MultiTenantEngine(16, num_tenants=3, min_bucket=64, obs=obs)
+    srv = ArenaServer(engine=eng, max_staleness_matches=0, obs=obs)
+    matchmaker = Matchmaker(srv)
+    server = ArenaHTTPServer(srv, matchmaker=matchmaker).start()
+    client = WireClient(server.host, server.port)
+    try:
+        rng = np.random.default_rng(1)
+        for t in range(3):
+            a = rng.integers(0, 16, 80).astype(np.int32)
+            b = ((a + 1 + rng.integers(0, 15, 80)) % 16).astype(np.int32)
+            eng.ingest(a, b, tenant=t)
+        status, resp = client.propose_matches(5, tenant=1)
+        assert status == 200 and resp["tenant"] == 1
+        assert resp["proposals"]
+        view, _stale = srv._serve_view()
+        scale = float(eng.scale)
+        r = np.asarray(view.ratings, np.float64)
+        for row in resp["proposals"]:
+            a, b = row["a"], row["b"]
+            assert 0 <= a < 16 and 0 <= b < 16
+            ra, rb = r[16 + a], r[16 + b]
+            want = 1.0 / (1.0 + 10.0 ** ((rb - ra) / scale))
+            assert row["p_a_beats_b"] == pytest.approx(want, abs=1e-5)
+        # Tenant streams are independent: same watermark, same n, but
+        # tenant-salted RNG -> scoped proposals differ from global.
+        status, global_resp = client.propose_matches(5)
+        assert "tenant" not in global_resp
+        status, resp2 = client.propose_matches(5, tenant=2)
+        assert resp2["proposals"] != resp["proposals"]
+        for bad in (3, -1):
+            status, err = client.propose_matches(5, tenant=bad)
+            assert status == 400 and "unknown tenant" in err["error"]
+    finally:
+        client.close()
+        server.close()
+        matchmaker.close()
+        srv.close()
+
+
+# --- degenerate rosters and request bounds ---------------------------------
+
+
+def test_degenerate_rosters_and_bounds():
+    # One player: no admissible pair, not an error. (The engine itself
+    # refuses a 1-player arena, so this exercises the pure function on
+    # a 1-player view — the tenant-scoped shape a 1-player tenant
+    # would produce.)
+    class _OnePlayerView:
+        ratings = np.zeros(1, np.float32)
+
+    assert propose_pairs(_OnePlayerView(), 4, "active", pair_fn=None) == []
+    obs = Observability()
+    srv = ArenaServer(num_players=2, max_staleness_matches=0, obs=obs)
+    matchmaker = Matchmaker(srv)
+    try:
+        # n=0 is a valid no-op request.
+        assert matchmaker.propose(0)[3] == []
+        with pytest.raises(ValueError, match=">= 0"):
+            matchmaker.propose(-1)
+        with pytest.raises(ValueError, match=str(MAX_PROPOSALS)):
+            matchmaker.propose(MAX_PROPOSALS + 1)
+        with pytest.raises(ValueError, match="unknown match policy"):
+            matchmaker.propose(2, policy="bogus")
+    finally:
+        matchmaker.close()
+        srv.close()
+
+
+def test_all_equal_cis_still_propose_distinct_pairs():
+    """Before any interval refresh every CI is equally unknown (the
+    uniform-width degenerate case): active must still produce n
+    distinct, round-constrained pairs instead of collapsing onto one
+    argmax pair."""
+    obs = Observability()
+    srv = ArenaServer(num_players=12, max_staleness_matches=0, obs=obs)
+    matchmaker = Matchmaker(srv)
+    try:
+        srv.engine.ingest(
+            np.arange(6, dtype=np.int32), np.arange(6, 12, dtype=np.int32)
+        )
+        view, _ = srv._serve_view()
+        assert view.lo is None  # intervals never refreshed
+        _v, _s, _p, rows = matchmaker.propose(6, policy="active")
+        assert len(rows) == 6
+        pairs = {(a, b) for a, b, _p2, _s2 in rows}
+        assert len(pairs) == 6
+        players = [x for a, b, _p2, _s2 in rows for x in (a, b)]
+        assert len(players) == len(set(players))
+    finally:
+        matchmaker.close()
+        srv.close()
+
+
+# --- the wire surface ------------------------------------------------------
+
+
+def test_match_envelope_watermark_is_the_views():
+    """The named kill for match-envelope-omits-watermark: the /match
+    envelope's watermark is the PROPOSING view's, not the live
+    counter. With a staleness allowance the two diverge — rename the
+    payload key and `make_response` silently falls back to
+    `matches_applied`, stamping proposals with freshness they were
+    never ranked at."""
+    obs = Observability()
+    srv = ArenaServer(num_players=16, max_staleness_matches=10_000, obs=obs)
+    matchmaker = Matchmaker(srv)
+    server = ArenaHTTPServer(srv, matchmaker=matchmaker).start()
+    client = WireClient(server.host, server.port)
+    try:
+        srv.engine.ingest(
+            np.arange(8, dtype=np.int32), np.arange(8, 16, dtype=np.int32)
+        )
+        view, _ = srv._serve_view()  # pin the view at watermark 8
+        srv.engine.ingest(
+            np.arange(8, dtype=np.int32), np.arange(8, 16, dtype=np.int32)
+        )
+        assert srv.engine.matches_applied == 16
+        status, resp = client.propose_matches(3)
+        assert status == 200
+        # The envelope watermark is the VIEW's (8), not the live
+        # counter (16) `make_response` would fall back to if the
+        # payload dropped its watermark.
+        assert resp["watermark"] == view.watermark == 8
+        assert resp["watermark"] != srv.engine.matches_applied
+        # Every other header field is view-stable too.
+        assert resp["matches_ingested"] == 8
+        assert resp["staleness"] == 0
+    finally:
+        client.close()
+        server.close()
+        matchmaker.close()
+        srv.close()
+
+
+def test_match_counters_slo_and_presence(stack):
+    """The ops surface: request/proposal counters reconcile with the
+    traffic, the `match-proposal-latency` SLO objective is registered
+    on the server's burn-rate engine, /healthz and stats()["net"]
+    carry the presence bit, and close() drops it."""
+    server, client, matchmaker = stack
+    srv = server.server
+    net = srv.stats()["net"]["matchmaker"]
+    req0, prop0 = net["requests"], net["proposals"]
+    status, resp = client.propose_matches(4)
+    assert status == 200 and len(resp["proposals"]) == 4
+    net = srv.stats()["net"]["matchmaker"]
+    assert net["present"] is True
+    assert net["requests"] == req0 + 1
+    assert net["proposals"] == prop0 + 4
+    assert "match-proposal-latency" in srv.obs.slo.evaluate()["objectives"]
+    _status, health = client.get("/healthz")
+    assert health["matchmaker"] is True
+    # A second matchmaker on the same server must not double-register
+    # the SLO objective.
+    extra = Matchmaker(srv)
+    extra.close()
+    # close() drops the presence gauge (stats), tested on `extra` so
+    # the shared fixture keeps serving.
+    assert srv.stats()["net"]["matchmaker"]["present"] is False
+    matchmaker._g_present.set(1)  # restore the fixture's bit
+
+
+def test_match_503_without_matchmaker_and_thread_front_end_parity():
+    """A server with no matchmaker 503s /match but serves everything
+    else; the legacy threaded front end serves /match through the same
+    dispatch — same watermark, same proposals, bit-equal."""
+    obs = Observability()
+    srv = ArenaServer(num_players=12, max_staleness_matches=0, obs=obs)
+    srv.engine.ingest(
+        np.arange(6, dtype=np.int32), np.arange(6, 12, dtype=np.int32)
+    )
+    bare = ArenaHTTPServer(srv).start()
+    bare_client = WireClient(bare.host, bare.port)
+    try:
+        status, resp = bare_client.propose_matches(2)
+        assert status == 503 and "no matchmaker" in resp["error"]
+        status, _health = bare_client.get("/healthz")
+        assert _health["matchmaker"] is False
+    finally:
+        bare_client.close()
+        bare.close()
+    matchmaker = Matchmaker(srv)
+    fast = ArenaHTTPServer(srv, matchmaker=matchmaker).start()
+    threaded = ArenaHTTPServer(
+        srv, matchmaker=matchmaker, fastpath_reads=False
+    ).start()
+    c_fast = WireClient(fast.host, fast.port)
+    c_thread = WireClient(threaded.host, threaded.port)
+    try:
+        s1, r1 = c_fast.propose_matches(4, policy="ucb")
+        s2, r2 = c_thread.propose_matches(4, policy="ucb")
+        assert s1 == s2 == 200
+        assert r1["proposals"] == r2["proposals"]
+        assert r1["watermark"] == r2["watermark"]
+        status, err = c_fast.propose_matches(4, policy="bogus")
+        assert status == 400 and "unknown match policy" in err["error"]
+    finally:
+        c_fast.close()
+        c_thread.close()
+        fast.close()
+        threaded.close()
+        matchmaker.close()
+        srv.close()
+
+
+def test_epsilon_policy_mixes_but_replays(stack):
+    """epsilon-greedy at epsilon=1.0 replaces every slot with its
+    exploration draw — still watermark-seeded, still replayable."""
+    server, _client, _matchmaker = stack
+    view, _ = server.server._serve_view()
+    mm_pair = _matchmaker._pair_fn
+    rows1 = propose_pairs(view, 8, "epsilon", mm_pair, epsilon=1.0)
+    rows2 = propose_pairs(view, 8, "epsilon", mm_pair, epsilon=1.0)
+    assert rows1 == rows2
+    assert len(rows1) == 8
+    greedy = propose_pairs(view, 8, "epsilon", mm_pair, epsilon=0.0)
+    assert greedy != rows1

@@ -85,7 +85,9 @@ def test_mutations_cover_every_policed_surface():
     replica's strict-sequence apply, the incremental snapshot chain's
     base-identity link, the staleness objective's burn-rate pull), and
     since PR 19 the multi-tenant plane (the composite-id tenant key,
-    the pow2 tenant bucket, the wire tenant sanitizer)."""
+    the pow2 tenant bucket, the wire tenant sanitizer), and since
+    PR 20 the matchmaking plane (the active policy's CI-width blend,
+    the matchloop convergence gate, the /match envelope watermark)."""
     files = {relpath for _n, relpath, _o, _nw, _p in mutation_audit.MUTATIONS}
     assert files == {
         "bench.py",
@@ -113,6 +115,7 @@ def test_mutations_cover_every_policed_surface():
         "arena/net/server.py",
         "arena/net/fastpath.py",
         "arena/net/replica.py",
+        "arena/match/matchmaker.py",
     }
 
 
@@ -160,6 +163,7 @@ def _fake_sources_only(dest):
         "arena/net/server.py",
         "arena/net/fastpath.py",
         "arena/net/replica.py",
+        "arena/match/matchmaker.py",
     ):
         target = dest / name
         target.parent.mkdir(parents=True, exist_ok=True)

@@ -15,6 +15,7 @@ port), with per-test state asserted as deltas.
 import numpy as np
 import pytest
 
+from arena.match import Matchmaker
 from arena.net import (
     ArenaHTTPServer,
     FrontDoor,
@@ -39,11 +40,15 @@ def wire():
     b = ((a + 1 + rng.integers(0, PLAYERS - 1, 400)) % PLAYERS).astype(np.int32)
     srv.engine.ingest(a, b)
     frontdoor = FrontDoor(srv.engine, capacity=32, record_applied=True)
-    server = ArenaHTTPServer(srv, frontdoor=frontdoor).start()
+    matchmaker = Matchmaker(srv)
+    server = ArenaHTTPServer(
+        srv, frontdoor=frontdoor, matchmaker=matchmaker
+    ).start()
     client = WireClient(server.host, server.port)
     yield server, client
     client.close()
     server.close()
+    matchmaker.close()
     frontdoor.close()
     srv.close()
 
@@ -63,6 +68,7 @@ def test_every_wire_response_carries_watermark_and_trace_id(wire):
         "/player/3",
         "/h2h?a=1&b=2",
         "/healthz",
+        "/match?n=2",
         "/nope-not-an-endpoint",  # 404s keep the envelope too
     ]
     for path in json_paths:
@@ -220,12 +226,22 @@ def test_parse_path_routes_and_statuses():
     assert parse_path("POST", "/submit") == ("submit", {})
     assert parse_path("GET", "/stats") == ("stats", {})
     assert parse_path("GET", "/healthz") == ("healthz", {})
+    # PR 20: the matchmaking plane. `policy` passes through only when
+    # present (the matchmaker applies its own default), `n` defaults
+    # to the wire-level proposal count.
+    assert parse_path("GET", "/match") == ("match", {"n": 16})
+    assert parse_path("GET", "/match?n=8&policy=fair&tenant=1") == (
+        "match", {"n": 8, "policy": "fair", "tenant": 1},
+    )
     for method, path, status in [
         ("GET", "/", 404),
         ("GET", "/player", 404),
         ("GET", "/player/1/extra", 404),
         ("POST", "/leaderboard", 405),
         ("GET", "/h2h?a=1&b=x", 400),
+        ("GET", "/match?n=x", 400),
+        ("POST", "/match", 405),
+        ("GET", "/match/extra", 404),
     ]:
         with pytest.raises(ProtocolError) as exc:
             parse_path(method, path)
@@ -398,8 +414,11 @@ _ENVELOPE = {"watermark", "trace_id"}
 _QUERY_PARTS = {"matches_ingested", "staleness", "stale", "view_seq",
                 "view_ratings_sum"}
 GOLDEN_RESPONSE_KEYS = {
-    "/healthz": _ENVELOPE | {"status", "front_end", "players",
-                             "matches_ingested"},
+    "/healthz": _ENVELOPE | {"status", "front_end", "matchmaker",
+                             "players", "matches_ingested"},
+    # PR 20: the matchmaking plane's proposal page.
+    "/match?n=4": _ENVELOPE | {"matches_ingested", "staleness", "stale",
+                               "view_seq", "policy", "n", "proposals"},
     "/leaderboard?offset=0&limit=5": _ENVELOPE | _QUERY_PARTS | {"leaderboard"},
     "/player/3": _ENVELOPE | _QUERY_PARTS | {"players"},
     "/h2h?a=1&b=2": _ENVELOPE | _QUERY_PARTS | {"pairs"},
@@ -455,6 +474,11 @@ def test_every_endpoint_matches_its_golden_key_set(wire):
     for rec in log_page["records"]:
         assert set(rec) == {"seq", "kind", "winners", "losers",
                             "record_watermark", "tenant"}
+    # /match proposal rows are the wire-match proposal shape.
+    _status, page = client.get("/match?n=4")
+    assert page["proposals"], "48 ingested players must yield proposals"
+    for row in page["proposals"]:
+        assert set(row) == {"a", "b", "p_a_beats_b", "score"}
 
 
 def test_as_of_responses_match_the_golden_query_shape(wire, tmp_path):
@@ -508,6 +532,7 @@ def test_golden_key_sets_stay_inside_the_checked_in_sidecars():
         "/debug/slo": "wire-debug-slo",
         "/debug/profile": "wire-debug-profile",
         "/log?after_seq=-1&limit=2": "wire-log-segment",
+        "/match?n=4": "wire-match",
     }
     envelope = declared("wire-envelope")
     assert envelope == _ENVELOPE

@@ -194,9 +194,10 @@ def test_repo_baseline_file_is_valid():
     assert set(doc["metrics"]) == {
         "arena_elo_update_speedup", "arena_ingest", "arena_pipeline",
         "arena_serve", "arena_soak", "arena_frontend", "arena_replica",
-        "arena_tenant",
+        "arena_tenant", "arena_matchloop",
     }
     assert doc["metrics"]["arena_soak"]["direction"] == "lower"
+    assert doc["metrics"]["arena_matchloop"]["direction"] == "higher"
     assert doc["metrics"]["arena_tenant"]["direction"] == "higher"
     assert doc["metrics"]["arena_frontend"]["direction"] == "higher"
     assert doc["metrics"]["arena_replica"]["direction"] == "higher"
